@@ -1,0 +1,59 @@
+"""Run configuration.
+
+The reference keeps runtime constants in a module of globals plus argparse
+flags (SURVEY.md section 3, "Global config", [M-med]); here a single frozen
+dataclass is threaded through the stack instead, with the CLI (main.py)
+populating it for parity.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class PartitionConfig:
+    """Configuration for an offline partition build.
+
+    Mirrors the reference CLI surface (example name, eps_a/eps_r, algorithm
+    variant, process count -- SURVEY.md section 2 L8) with TPU-native fields
+    (backend, mesh, batch size) replacing the MPI process count.
+    """
+
+    # Which benchmark problem (problems/registry.py).
+    problem: str = "double_integrator"
+    # Absolute suboptimality tolerance (eps_a <= 0 disables the check).
+    eps_a: float = 1e-2
+    # Relative suboptimality tolerance (eps_r <= 0 disables the check).
+    eps_r: float = 0.0
+    # 'suboptimal' = fully-explicit eps-suboptimal partition (the reference's
+    # L-CSS algorithm); 'feasible' = semi-explicit feasibility-only partition
+    # (the reference's ECC algorithm).  SURVEY.md section 1 "two variants" [P].
+    algorithm: str = "suboptimal"
+    # Oracle execution backend: 'tpu' (or whatever jax.devices() offers) vs
+    # 'cpu' (same kernel on CPU devices) vs 'serial' (scipy reference oracle,
+    # the stand-in for the reference's serial Gurobi baseline).
+    backend: str = "tpu"
+    # Device-batch padding size for the frontier solve (static shape; the
+    # frontier is packed/padded to this many simplices per step).
+    batch_simplices: int = 256
+    # Maximum tree depth (safety valve against runaway subdivision).
+    max_depth: int = 40
+    # Maximum number of frontier steps.
+    max_steps: int = 10_000
+    # Snapshot the frontier + tree every N steps (0 disables).  SURVEY.md
+    # section 6.4: build obligation "frontier checkpointing".
+    checkpoint_every: int = 0
+    checkpoint_path: Optional[str] = None
+    # Structured JSONL metrics stream (SURVEY.md section 6.5).
+    log_path: Optional[str] = None
+    # Mesh axis size for sharding the solve batch (None = all local devices).
+    mesh_devices: Optional[int] = None
+    seed: int = 0
+
+    def __post_init__(self) -> None:
+        if self.algorithm not in ("suboptimal", "feasible"):
+            raise ValueError(f"unknown algorithm {self.algorithm!r}")
+        if self.eps_a <= 0 and self.eps_r <= 0 and self.algorithm == "suboptimal":
+            raise ValueError("suboptimal variant needs eps_a > 0 or eps_r > 0")

@@ -1,0 +1,77 @@
+"""Online PWA controller evaluation (pure-JAX reference implementation).
+
+u(theta): locate the leaf simplex containing theta, take barycentric
+weights lambda, return u = sum_i lambda_i u_i -- the reference's online
+algorithm (SURVEY.md section 4.2, [P]), executed as one fixed-shape device
+program over the exported leaf table.
+
+Point location here is blocked brute force: compute lambda for EVERY leaf
+and select the leaf with the least-negative minimum barycentric coordinate
+(inside <=> min_i lambda_i >= 0).  On TPU this is a batched matmul over
+leaves -- bandwidth-bound, microseconds for 10^4-10^5 leaves, and exactly
+parallel; the O(depth) tree descent the reference uses is a host-side
+alternative (partition.tree.Tree.locate).  online/pallas_eval.py provides
+the hand-tiled kernel version of the same contraction.
+
+A query outside every simplex (or in an uncertified hole) returns the
+best-matching leaf anyway; callers needing strict domain checks read the
+returned `inside` flag.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from explicit_hybrid_mpc_tpu.online.export import LeafTable
+
+
+class EvalResult(NamedTuple):
+    u: jax.Array        # (B, n_u)
+    cost: jax.Array     # (B,) interpolated vertex cost (certified upper bd)
+    leaf: jax.Array     # (B,) leaf row index
+    inside: jax.Array   # (B,) bool: min barycentric coord >= -tol
+
+
+class DeviceLeafTable(NamedTuple):
+    bary_M: jax.Array
+    U: jax.Array
+    V: jax.Array
+
+
+def stage(table: LeafTable) -> DeviceLeafTable:
+    return DeviceLeafTable(bary_M=jnp.asarray(table.bary_M),
+                           U=jnp.asarray(table.U),
+                           V=jnp.asarray(table.V))
+
+
+@functools.partial(jax.jit, static_argnames=())
+def evaluate(dev: DeviceLeafTable, thetas: jax.Array,
+             tol: float = 1e-9) -> EvalResult:
+    """Batched PWA evaluation: thetas (B, p) -> EvalResult."""
+    B, p = thetas.shape
+    th1 = jnp.concatenate([thetas, jnp.ones((B, 1), thetas.dtype)], axis=1)
+    # lam[b, l, i] = bary_M[l, i, :] . th1[b]  -- one big contraction.
+    lam = jnp.einsum("lij,bj->bli", dev.bary_M, th1)
+    score = jnp.min(lam, axis=-1)             # (B, L) containment margin
+    leaf = jnp.argmax(score, axis=-1)         # best (first on ties)
+    lam_best = jnp.take_along_axis(
+        lam, leaf[:, None, None], axis=1)[:, 0, :]          # (B, p+1)
+    U_best = dev.U[leaf]                      # (B, p+1, n_u)
+    V_best = dev.V[leaf]                      # (B, p+1)
+    u = jnp.einsum("bi,bin->bn", lam_best, U_best)
+    cost = jnp.einsum("bi,bi->b", lam_best, V_best)
+    inside = jnp.max(score, axis=-1) >= -tol
+    return EvalResult(u=u, cost=cost, leaf=leaf, inside=inside)
+
+
+def evaluate_np(table: LeafTable, theta: np.ndarray) -> np.ndarray:
+    """Single-point numpy evaluation (host reference for tests)."""
+    th1 = np.concatenate([theta, [1.0]])
+    lam = table.bary_M @ th1                  # (L, p+1)
+    leaf = int(np.argmax(lam.min(axis=1)))
+    return table.U[leaf].T @ lam[leaf]

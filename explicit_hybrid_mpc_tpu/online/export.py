@@ -1,0 +1,56 @@
+"""Export a built Tree's converged leaves to flat device arrays.
+
+The reference deploys its controller by descending the pickled tree in
+Python (SURVEY.md section 4.2); the TPU-native online stage instead consumes
+a flat table of leaves -- per leaf the barycentric matrix (lambda =
+bary_M @ [theta;1]) and the vertex input matrix -- so point location +
+affine evaluation is one fixed-shape device program (BASELINE.json
+north-star: "a Pallas point-in-simplex + affine-eval kernel").
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import numpy as np
+
+from explicit_hybrid_mpc_tpu.partition import geometry
+from explicit_hybrid_mpc_tpu.partition.tree import Tree
+
+
+class LeafTable(NamedTuple):
+    """Flat leaf arrays (numpy; jnp.asarray to stage on device).
+
+    bary_M:   (L, p+1, p+1) -- lambda(theta) = bary_M @ [theta; 1]
+    U:        (L, p+1, n_u) -- vertex first-move inputs
+    V:        (L, p+1)      -- vertex costs (for cost readout)
+    delta:    (L,)          -- commutation index per leaf
+    node_id:  (L,)          -- tree node of each row (for cross-checks)
+    """
+
+    bary_M: np.ndarray
+    U: np.ndarray
+    V: np.ndarray
+    delta: np.ndarray
+    node_id: np.ndarray
+
+    @property
+    def n_leaves(self) -> int:
+        return self.bary_M.shape[0]
+
+
+def export_leaves(tree: Tree) -> LeafTable:
+    ids = tree.converged_leaves()
+    if not ids:
+        raise ValueError("tree has no converged leaves")
+    Ms, Us, Vs, ds = [], [], [], []
+    for n in ids:
+        Ms.append(geometry.barycentric_matrix(tree.vertices[n]))
+        ld = tree.leaf_data[n]
+        Us.append(ld.vertex_inputs)
+        Vs.append(ld.vertex_costs)
+        ds.append(ld.delta_idx)
+    return LeafTable(
+        bary_M=np.stack(Ms), U=np.stack(Us), V=np.stack(Vs),
+        delta=np.asarray(ds, dtype=np.int32),
+        node_id=np.asarray(ids, dtype=np.int32))

@@ -1,0 +1,152 @@
+"""Batched dense primal-dual interior-point QP solver (JAX).
+
+This is the compute heart of the framework: the reference's hot loop is one
+serial Gurobi MICP solve per simplex vertex (SURVEY.md section 4.1, [NS]
+"serial Gurobi oracle"); here the same work is a *vmapped fixed-shape,
+fixed-iteration Mehrotra predictor-corrector* that solves thousands of
+(point x commutation) QPs in one XLA program.  Design notes:
+
+- Fixed iteration count + static shapes: no data-dependent control flow, so
+  the whole frontier step fuses into one compiled program; the MXU sees
+  large batched Cholesky/matmul work (SURVEY.md section 8 layer 2).
+- float64: IPMs are ill-conditioned near convergence (TPU emulates f64;
+  correctness first -- SURVEY.md section 8 "hard parts" item 2).
+- No early exit: converged problems keep iterating harmlessly (steps go to
+  zero); a `converged` mask is computed from final residuals.
+- Infeasible problems cannot converge in primal residual; they are
+  classified by residual thresholds.  Decisions that must be SOUND
+  (certifying a simplex empty, excluding a commutation from the V* lower
+  bound) instead go through `phase1`-style elastic solves plus a Farkas
+  dual check (oracle.Oracle.simplex_feasibility).
+
+Problem form (one batch element):
+    min_z 1/2 z'Qz + q'z   s.t.  A z <= b
+KKT with slacks s >= 0, multipliers lam >= 0:
+    Qz + q + A'lam = 0;  Az + s - b = 0;  s .* lam = 0.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class QPSolution(NamedTuple):
+    z: jax.Array        # (..., nz) primal solution
+    lam: jax.Array      # (..., nc) dual solution
+    s: jax.Array        # (..., nc) slacks
+    obj: jax.Array      # (...,) 1/2 z'Qz + q'z at the returned z
+    rp: jax.Array       # (...,) final primal residual (inf-norm, relative)
+    rd: jax.Array       # (...,) final dual residual (inf-norm, relative)
+    gap: jax.Array      # (...,) final complementarity mu (relative)
+    converged: jax.Array  # (...,) bool: KKT satisfied to tolerance
+    feasible: jax.Array   # (...,) bool: primal residual small (a converged
+    #                       point exists; infeasible QPs keep rp large)
+
+
+_TINY = 1e-12
+
+
+def _fraction_to_boundary(v: jax.Array, dv: jax.Array, tau: float) -> jax.Array:
+    """Largest alpha in (0, 1] with v + alpha*dv >= (1-tau)*... standard
+    fraction-to-boundary: alpha = min(1, tau * min_{dv<0} (-v/dv))."""
+    ratio = jnp.where(dv < 0, -v / jnp.where(dv < 0, dv, -1.0), jnp.inf)
+    return jnp.minimum(1.0, tau * jnp.min(ratio, axis=-1))
+
+
+def qp_solve(Q: jax.Array, q: jax.Array, A: jax.Array, b: jax.Array,
+             n_iter: int = 30, tol: float = 1e-8) -> QPSolution:
+    """Solve one dense convex QP with Mehrotra predictor-corrector.
+
+    Shapes: Q (nz,nz) PD, q (nz,), A (nc,nz), b (nc,).  vmap freely.
+    """
+    nz = Q.shape[-1]
+    nc = A.shape[-2]
+    dtype = Q.dtype
+    reg = jnp.asarray(1e-10, dtype)
+
+    # Initial point: unconstrained minimizer, unit slacks/duals shifted to
+    # cover the initial primal infeasibility (standard Mehrotra start).
+    Lq = jnp.linalg.cholesky(Q + reg * jnp.eye(nz, dtype=dtype))
+    z0 = -jax.scipy.linalg.cho_solve((Lq, True), q)
+    resid0 = A @ z0 - b
+    shift = jnp.maximum(1.0, 1.1 * jnp.max(jnp.maximum(resid0, 0.0)))
+    s0 = jnp.maximum(b - A @ z0, 0.0) + shift
+    lam0 = jnp.ones(nc, dtype=dtype)
+
+    scale_p = 1.0 + jnp.max(jnp.abs(b))
+    scale_d = 1.0 + jnp.max(jnp.abs(q))
+
+    def body(_, carry):
+        z, s, lam = carry
+        s = jnp.maximum(s, _TINY)
+        lam = jnp.maximum(lam, _TINY)
+        r_d = Q @ z + q + A.T @ lam
+        r_p = A @ z + s - b
+        mu = jnp.dot(s, lam) / nc
+
+        D = lam / s
+        M = Q + (A.T * D) @ A
+        L = jnp.linalg.cholesky(M + reg * jnp.eye(nz, dtype=dtype))
+
+        def kkt_step(r_c):
+            # r_c is the complementarity residual target: S*lam - r_c = 0
+            # linearized; eliminates (ds, dlam) onto the z block.
+            rhs = -r_d - A.T @ (D * r_p - r_c / s)
+            dz = jax.scipy.linalg.cho_solve((L, True), rhs)
+            dlam = D * (A @ dz + r_p) - r_c / s
+            ds = -(r_c + s * dlam) / lam
+            return dz, ds, dlam
+
+        # Predictor (affine scaling direction).
+        dz_a, ds_a, dlam_a = kkt_step(s * lam)
+        a_p = _fraction_to_boundary(s, ds_a, 1.0)
+        a_d = _fraction_to_boundary(lam, dlam_a, 1.0)
+        mu_aff = jnp.dot(s + a_p * ds_a, lam + a_d * dlam_a) / nc
+        sigma = (mu_aff / jnp.maximum(mu, _TINY)) ** 3
+
+        # Corrector with centering.
+        r_c = s * lam + ds_a * dlam_a - sigma * mu
+        dz, ds, dlam = kkt_step(r_c)
+        a_p = _fraction_to_boundary(s, ds, 0.995)
+        a_d = _fraction_to_boundary(lam, dlam, 0.995)
+        return (z + a_p * dz, s + a_p * ds, lam + a_d * dlam)
+
+    z, s, lam = jax.lax.fori_loop(0, n_iter, body, (z0, s0, lam0))
+
+    r_p = jnp.max(jnp.abs(A @ z + s - b)) / scale_p
+    r_d = jnp.max(jnp.abs(Q @ z + q + A.T @ lam)) / scale_d
+    gap = jnp.dot(s, lam) / nc / scale_d
+    obj = 0.5 * z @ Q @ z + q @ z
+    # Infeasible problems diverge (lam blows up; residuals may go NaN/inf) --
+    # any non-finite iterate is classified not-converged, not-feasible.
+    finite = (jnp.all(jnp.isfinite(z)) & jnp.isfinite(r_p) & jnp.isfinite(r_d)
+              & jnp.isfinite(gap))
+    converged = finite & (r_p < tol) & (r_d < tol) & (gap < tol)
+    feasible = finite & (r_p < jnp.sqrt(tol))
+    return QPSolution(z=z, lam=lam, s=s, obj=obj, rp=r_p, rd=r_d, gap=gap,
+                      converged=converged, feasible=feasible)
+
+
+def phase1(A: jax.Array, b: jax.Array, n_iter: int = 30,
+           rho: float = 1e-4) -> jax.Array:
+    """Minimal constraint violation t* = min max(A z - b) (smoothed).
+
+    Solves min_z,t 1/2 rho t^2 + t  s.t.  A z - t <= b, a strictly feasible
+    QP whose optimum t* <= 0 iff {z : Az <= b} is nonempty (up to rho
+    smoothing, which only pulls t* DOWN by <= 1/(2 rho) when strictly
+    feasible -- decisions use t* <= tol).  Used by the feasibility-only
+    ('feasible'/ECC) partition variant for clean feasibility certificates.
+    Returns t*.
+    """
+    nz = A.shape[-1]
+    nc = A.shape[-2]
+    dtype = A.dtype
+    Q = jnp.eye(nz + 1, dtype=dtype) * 1e-6
+    Q = Q.at[nz, nz].set(rho)
+    q = jnp.zeros(nz + 1, dtype=dtype).at[nz].set(1.0)
+    At = jnp.concatenate([A, -jnp.ones((nc, 1), dtype=dtype)], axis=1)
+    sol = qp_solve(Q, q, At, b, n_iter=n_iter)
+    return sol.z[nz]

@@ -1,0 +1,331 @@
+"""The Oracle: the solver plugin boundary.
+
+The reference's Oracle wraps a problem into the queries the partitioner
+needs -- full MICP at a point, fixed-commutation convex problem, and
+simplex-wide bound subproblems (SURVEY.md section 3, [NS] "existing
+Oracle/solver plugin boundary"; method names UNVERIFIED, mount empty).
+
+This Oracle exposes the same three query classes, redesigned for batched
+device execution:
+
+- `solve_vertices(thetas)`  -- for a batch of parameter points, solve the
+  fixed-commutation QP for EVERY commutation (enumeration replaces
+  branch-and-bound) and reduce to V*(theta), delta*(theta).  One vmapped
+  IPM call over (points x commutations).
+- `solve_simplex_min(simplices, delta_idx)` -- exact min of V_delta over a
+  simplex via the joint QP in (z, theta), used by the eps-certificate when
+  vertex tangent bounds are unavailable (see partition/certificates.py).
+- `simplex_feasibility(simplices, delta_idx)` / `feasibility(thetas,
+  delta_idx)` -- phase-1 minimal-violation queries (+ Farkas dual check for
+  the simplex form), used to certify infeasible leaves and as a public
+  diagnostic; the feasibility-variant leaf rule itself decides from the
+  vertex cost-solve convergence flags (certify.certify_feasible).
+
+Backends (BASELINE.json north-star: "selectable as backend='tpu'"):
+- 'tpu' / 'cpu': the vmapped kernel jitted on that platform's devices.
+- 'serial': the same kernel, one problem at a time in a Python loop on CPU
+  -- the stand-in for the reference's serial-Gurobi baseline that bench.py
+  measures speedups against.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from explicit_hybrid_mpc_tpu.oracle import ipm
+from explicit_hybrid_mpc_tpu.problems.base import CanonicalMPQP
+
+_INF = np.inf
+
+
+class DeviceProblem(NamedTuple):
+    """CanonicalMPQP staged as jnp arrays (one slice per commutation)."""
+
+    H: jax.Array
+    f: jax.Array
+    F: jax.Array
+    G: jax.Array
+    w: jax.Array
+    S: jax.Array
+    Y: jax.Array
+    pvec: jax.Array
+    cconst: jax.Array
+    u_map: jax.Array
+
+
+def to_device(can: CanonicalMPQP) -> DeviceProblem:
+    return DeviceProblem(*(jnp.asarray(getattr(can, k))
+                           for k in DeviceProblem._fields))
+
+
+class VertexSolution(NamedTuple):
+    """Per-point oracle results (host numpy). P points, nd commutations."""
+
+    V: np.ndarray        # (P, nd) fixed-commutation value; +inf if invalid
+    conv: np.ndarray     # (P, nd) bool, solver converged (cost trustworthy)
+    grad: np.ndarray     # (P, nd, n_theta) dV_delta/dtheta
+    u0: np.ndarray       # (P, nd, n_u) first control move
+    z: np.ndarray        # (P, nd, nz) full primal solution (interpolating
+    #                      full sequences carries the certificate guarantee)
+    Vstar: np.ndarray    # (P,) min over valid commutations; +inf if none
+    dstar: np.ndarray    # (P,) argmin commutation; -1 if none valid
+
+
+def _solve_one(prob: DeviceProblem, theta: jax.Array, d: int, n_iter: int):
+    """Fixed-commutation QP at one point: P_theta_delta in reference terms
+    (SURVEY.md section 3, UNVERIFIED naming)."""
+    q = prob.f[d] + prob.F[d] @ theta
+    b = prob.w[d] + prob.S[d] @ theta
+    sol = ipm.qp_solve(prob.H[d], q, prob.G[d], b, n_iter=n_iter)
+    theta_cost = (0.5 * theta @ prob.Y[d] @ theta + prob.pvec[d] @ theta
+                  + prob.cconst[d])
+    V = sol.obj + theta_cost
+    # Envelope theorem: dV/dtheta = F'z* + Y theta + p - S'lam*.
+    grad = (prob.F[d].T @ sol.z + prob.Y[d] @ theta + prob.pvec[d]
+            - prob.S[d].T @ sol.lam)
+    u0 = prob.u_map[d] @ sol.z
+    return V, sol.converged, grad, u0, sol.z
+
+
+def _solve_points_all_deltas(prob: DeviceProblem, thetas: jax.Array,
+                             n_iter: int):
+    """(P points) x (nd commutations) in one vmapped program."""
+    nd = prob.H.shape[0]
+
+    def per_point(theta):
+        V, conv, grad, u0, z = jax.vmap(
+            lambda d: _solve_one(prob, theta, d, n_iter))(jnp.arange(nd))
+        Vval = jnp.where(conv, V, jnp.inf)
+        dstar = jnp.argmin(Vval)  # first minimum: deterministic tie-break
+        Vstar = Vval[dstar]
+        return V, conv, grad, u0, z, Vstar, dstar
+
+    return jax.vmap(per_point)(thetas)
+
+
+def _simplex_feas_one(prob: DeviceProblem, bary_M: jax.Array, d: int,
+                      n_iter: int):
+    """Joint phase-1 over a simplex: t* = min violation of commutation d's
+    constraints over {(z, theta) : theta in R}.
+
+    t* <= tol  => delta d is feasible SOMEWHERE in R.
+    Infeasibility on ALL of R (the positive evidence the certificate needs
+    before excluding d from the V* lower bound) requires BOTH t* > tol and
+    an approximate Farkas certificate from the phase-1 duals: y >= 0 with
+    A0'y ~ 0 and b'y < 0 proves {A0 x <= b} empty; checking it directly
+    makes the decision robust to the small primal regularization ridge,
+    which biases t* UPWARD and would otherwise be the unsound direction
+    (a feasible-but-ill-scaled problem could show t* > tol).
+    Returns (t*, converged, farkas_ok).
+    """
+    nz = prob.H.shape[1]
+    nt = prob.Y.shape[1]
+    dtype = prob.H.dtype
+    M_th = bary_M[:, :nt]
+    m_c = bary_M[:, nt]
+    nc = prob.G.shape[1]
+    nb = M_th.shape[0]
+    # Variables (z, theta, t): min ridge|z,theta|^2/2 + rho t^2/2 + t
+    # s.t. Gz - S theta - t <= w;  -M_theta theta <= m_c (t not elastic on
+    # the simplex rows: theta must stay IN R).
+    A = jnp.concatenate([
+        jnp.concatenate([prob.G[d], -prob.S[d],
+                         -jnp.ones((nc, 1), dtype=dtype)], axis=1),
+        jnp.concatenate([jnp.zeros((nb, nz), dtype=dtype), -M_th,
+                         jnp.zeros((nb, 1), dtype=dtype)], axis=1),
+    ])
+    b = jnp.concatenate([prob.w[d], m_c])
+    Q = jnp.eye(nz + nt + 1, dtype=dtype) * 1e-9
+    Q = Q.at[nz + nt, nz + nt].set(1e-6)
+    q = jnp.zeros(nz + nt + 1, dtype=dtype).at[nz + nt].set(1.0)
+    sol = ipm.qp_solve(Q, q, A, b, n_iter=n_iter)
+    # Farkas check on the ORIGINAL system A0 x <= b (t column dropped).
+    A0 = A[:, :nz + nt]
+    y = sol.lam / jnp.maximum(jnp.sum(sol.lam), 1e-300)
+    stat = jnp.max(jnp.abs(A0.T @ y)) / (1.0 + jnp.max(jnp.abs(A0)))
+    gain = b @ y / (1.0 + jnp.max(jnp.abs(b)))
+    farkas_ok = (stat <= 1e-7) & (gain <= -1e-9) & jnp.all(jnp.isfinite(y))
+    return sol.z[nz + nt], sol.converged, farkas_ok
+
+
+def _solve_simplex_min_one(prob: DeviceProblem, bary_M: jax.Array,
+                           d: int, n_iter: int):
+    """Exact min_{theta in R} V_delta(theta): joint QP over (z, theta).
+
+    bary_M is the (p+1, p+1) barycentric matrix of the simplex (lambda =
+    bary_M @ [theta;1]); theta-in-simplex is lambda >= 0.  The joint
+    Hessian [[H, F],[F', Y]] is PSD by construction (it is the original
+    stage-cost quadratic); a small ridge on the theta block keeps the IPM's
+    Cholesky PD.
+    """
+    nz = prob.H.shape[1]
+    nt = prob.Y.shape[1]
+    dtype = prob.H.dtype
+    ridge = 1e-9
+    Hj = jnp.block([[prob.H[d], prob.F[d]],
+                    [prob.F[d].T, prob.Y[d] + ridge * jnp.eye(nt, dtype=dtype)]])
+    qj = jnp.concatenate([prob.f[d], prob.pvec[d]])
+    # Gz - S theta <= w  and  -M_theta theta <= m_c (simplex membership).
+    M_th = bary_M[:, :nt]
+    m_c = bary_M[:, nt]
+    Gj = jnp.block([[prob.G[d], -prob.S[d]],
+                    [jnp.zeros((M_th.shape[0], nz), dtype=dtype), -M_th]])
+    bj = jnp.concatenate([prob.w[d], m_c])
+    sol = ipm.qp_solve(Hj, qj, Gj, bj, n_iter=n_iter)
+    return sol.obj + prob.cconst[d], sol.converged, sol.feasible
+
+
+class Oracle:
+    """Solver plugin boundary with selectable backend."""
+
+    def __init__(self, problem, backend: str = "cpu", n_iter: int = 30):
+        self.problem = problem
+        self.can = problem.canonical
+        self.backend = backend
+        self.n_iter = n_iter
+        self.n_solves = 0  # statistics: individual QP solves issued
+        if backend in ("tpu", "gpu", "device"):
+            platform = None  # default platform (the accelerator if present)
+        elif backend in ("cpu", "serial"):
+            platform = "cpu"
+        else:
+            raise ValueError(f"unknown backend {backend!r}")
+        devs = jax.devices(platform) if platform else jax.devices()
+        self.device = devs[0]
+        self.prob = jax.device_put(to_device(self.can), self.device)
+
+        self._solve_points = jax.jit(
+            functools.partial(_solve_points_all_deltas, n_iter=self.n_iter),
+            static_argnames=())
+        self._solve_one_point = jax.jit(
+            lambda prob, theta: _solve_points_all_deltas(
+                prob, theta[None], self.n_iter))
+        self._simplex_min = jax.jit(
+            jax.vmap(lambda M, d: _solve_simplex_min_one(
+                self.prob, M, d, self.n_iter), in_axes=(0, 0)))
+        self._simplex_feas = jax.jit(
+            jax.vmap(lambda M, d: _simplex_feas_one(
+                self.prob, M, d, self.n_iter), in_axes=(0, 0)))
+        self._point_feas = jax.jit(
+            jax.vmap(lambda th, d: ipm.phase1(
+                self.prob.G[d],
+                self.prob.w[d] + self.prob.S[d] @ th,
+                n_iter=self.n_iter), in_axes=(0, 0)))
+
+    # -- the MICP-at-a-point query (reference: P_theta) --------------------
+
+    def solve_vertices(self, thetas: np.ndarray) -> VertexSolution:
+        """Solve the full enumeration at each point; pads the point batch
+        to power-of-two buckets so jit caches stay warm."""
+        thetas = np.atleast_2d(np.asarray(thetas, dtype=np.float64))
+        P = thetas.shape[0]
+        nd = self.can.n_delta
+        self.n_solves += P * nd
+        if self.backend == "serial":
+            outs = [self._solve_one_point(self.prob, jnp.asarray(t))
+                    for t in thetas]
+            parts = [np.concatenate([np.asarray(o[k]) for o in outs])
+                     for k in range(7)]
+            return VertexSolution(*self._finalize(parts))
+        Ppad = max(8, 1 << (P - 1).bit_length())
+        pad = np.zeros((Ppad - P, thetas.shape[1]))
+        out = self._solve_points(self.prob, jnp.asarray(
+            np.concatenate([thetas, pad])))
+        parts = [np.asarray(o)[:P] for o in out]
+        return VertexSolution(*self._finalize(parts))
+
+    @staticmethod
+    def _finalize(parts):
+        V, conv, grad, u0, z, Vstar, dstar = parts
+        V = np.where(conv, V, _INF)
+        dstar = np.where(np.isfinite(Vstar), dstar, -1)
+        return (V, conv.astype(bool), grad, u0, z, Vstar,
+                dstar.astype(np.int64))
+
+    # -- the simplex-wide bound query (reference: V_R-style) ---------------
+
+    def solve_simplex_min(self, bary_Ms: np.ndarray,
+                          delta_idx: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+        """min_{theta in R} V_delta(theta) for a batch of (simplex, delta).
+
+        Returns (Vmin, feasible_somewhere).  Encoding of Vmin:
+        - finite: exact simplex minimum (min-QP converged);
+        - +inf:   POSITIVE evidence of infeasibility on all of R (the
+                  always-strictly-feasible joint phase-1 converged with
+                  violation t* > tol) -- excludable from the V* lower bound;
+        - -inf:   no usable bound (either solve stalled) -- conservatively
+                  blocks certification, forcing a split.
+        """
+        K = bary_Ms.shape[0]
+        if K == 0:
+            return np.zeros(0), np.zeros(0, dtype=bool)
+        self.n_solves += 2 * K
+        Kpad = max(8, 1 << (K - 1).bit_length())
+        Mpad = np.concatenate(
+            [bary_Ms, np.tile(np.eye(bary_Ms.shape[1])[None],
+                              (Kpad - K, 1, 1))])
+        dpad = np.concatenate([delta_idx, np.zeros(Kpad - K, dtype=np.int64)])
+        Mj, dj = jnp.asarray(Mpad), jnp.asarray(dpad)
+        V, conv, _feas = self._simplex_min(Mj, dj)
+        t, t_conv, farkas = self._simplex_feas(Mj, dj)
+        V, conv = np.asarray(V), np.asarray(conv)
+        t, t_conv = np.asarray(t), np.asarray(t_conv)
+        infeasible = t_conv & (t > 1e-6) & np.asarray(farkas)
+        feasible_somewhere = t_conv & (t <= 1e-6)
+        out = np.where(conv, V, -_INF)
+        out = np.where(infeasible, _INF, out)
+        return out[:K], feasible_somewhere[:K]
+
+    def simplex_feasibility(self, bary_Ms: np.ndarray,
+                            delta_idx: np.ndarray
+                            ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Joint phase-1 over simplices: (t*, feasible_somewhere,
+        infeasible_certified) per (simplex, delta) row.
+
+        infeasible_certified requires t* > tol AND a Farkas dual
+        certificate (see _simplex_feas_one) -- this is the positive
+        evidence needed before declaring an infeasible leaf (the feasible
+        set of the hybrid problem is a union over commutations and need
+        not touch any vertex)."""
+        K = bary_Ms.shape[0]
+        if K == 0:
+            z = np.zeros(0)
+            return z, z.astype(bool), z.astype(bool)
+        self.n_solves += K
+        Kpad = max(8, 1 << (K - 1).bit_length())
+        Mpad = np.concatenate(
+            [bary_Ms, np.tile(np.eye(bary_Ms.shape[1])[None],
+                              (Kpad - K, 1, 1))])
+        dpad = np.concatenate([np.asarray(delta_idx, dtype=np.int64),
+                               np.zeros(Kpad - K, dtype=np.int64)])
+        t, conv, farkas = self._simplex_feas(jnp.asarray(Mpad),
+                                             jnp.asarray(dpad))
+        t, conv, farkas = (np.asarray(t), np.asarray(conv),
+                           np.asarray(farkas))
+        feas_somewhere = conv & (t <= 1e-6)
+        infeas_cert = conv & (t > 1e-6) & farkas
+        return t[:K], feas_somewhere[:K], infeas_cert[:K]
+
+    # -- pointwise feasibility (phase-1) -----------------------------------
+
+    def feasibility(self, thetas: np.ndarray,
+                    delta_idx: np.ndarray) -> np.ndarray:
+        """Minimal constraint violation t* of commutation delta_idx[k] at
+        point thetas[k] (<= tol means feasible).  Used by the
+        feasibility-only partition variant for decisions independent of the
+        cost solve's convergence."""
+        thetas = np.atleast_2d(np.asarray(thetas, dtype=np.float64))
+        K = thetas.shape[0]
+        self.n_solves += K
+        Kpad = max(8, 1 << (K - 1).bit_length())
+        tpad = np.concatenate(
+            [thetas, np.zeros((Kpad - K, thetas.shape[1]))])
+        dpad = np.concatenate([np.asarray(delta_idx, dtype=np.int64),
+                               np.zeros(Kpad - K, dtype=np.int64)])
+        t = self._point_feas(jnp.asarray(tpad), jnp.asarray(dpad))
+        return np.asarray(t)[:K]

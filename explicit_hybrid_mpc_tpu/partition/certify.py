@@ -1,0 +1,219 @@
+"""Simplex-wide eps-suboptimality and feasibility certificates.
+
+The certificate decides, for a leaf-candidate simplex R = conv{v_0..v_p} and
+a candidate commutation delta, whether the barycentric-interpolated control
+law with commutation delta is certified feasible and eps-suboptimal over ALL
+of R (SURVEY.md section 1 step 2b, [P]; section 8 "hard parts" item 5).
+
+Mathematical basis (each bound is sound; derivations in docs/certificates.md):
+
+U  (upper bound on the implemented cost):  V_delta is convex in theta, so the
+   affine interpolation of vertex values over-approximates it on R:
+   V_delta(theta) <= U(theta) := sum_i lam_i(theta) V_delta(v_i).
+
+L  (lower bound on the optimal cost V* = min_delta' V_delta'):
+   for every commutation delta' and any vertex v_i where the fixed-delta'
+   QP converged, the envelope-theorem tangent
+       l_{delta',i}(theta) = V_delta'(v_i) + g_delta'(v_i)'(theta - v_i)
+   under-approximates the convex V_delta' GLOBALLY (off its feasible set
+   V_delta' = +inf, so the bound holds trivially).  Hence
+       V*(theta) >= min_delta' max_i l_{delta',i}(theta).
+   For a delta' converged at NO vertex, the engine asks the oracle for the
+   exact simplex minimum min_{theta in R} V_delta'(theta) (a joint QP over
+   (z, theta)), a constant valid lower bound on R -- or a proof that delta'
+   is infeasible on all of R, excluding it from the min.
+
+Gap (all evaluated at vertices only -- affine functions on a simplex attain
+their extrema at vertices):
+   max_R [U - L] <= max_delta' min_i max_j [U(v_j) - l_{delta',i}(v_j)].
+The certificate passes when this gap is <= eps_a (absolute) or
+<= eps_r * min_j |V*(v_j)| (relative), matching the reference's eps_a/eps_r
+pair (SURVEY.md section 1, [NS] "absolute (eps_a) or relative (eps_r)
+suboptimality test").
+
+Feasibility over R is inherited from the vertices: the feasible set of the
+fixed-delta problem is convex in theta (projection of a polyhedron), so
+delta feasible at every vertex implies feasible on conv{v_i} = R.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class SimplexVertexData:
+    """Oracle results at the p+1 vertices of one simplex (host numpy)."""
+
+    verts: np.ndarray   # (p+1, p)
+    V: np.ndarray       # (p+1, nd) +inf where not converged
+    conv: np.ndarray    # (p+1, nd) bool
+    grad: np.ndarray    # (p+1, nd, p)
+    u0: np.ndarray      # (p+1, nd, n_u)
+    z: np.ndarray       # (p+1, nd, nz)
+    Vstar: np.ndarray   # (p+1,)
+    dstar: np.ndarray   # (p+1,)
+
+
+@dataclasses.dataclass
+class CertificateResult:
+    status: str                 # 'certified' | 'split' | 'infeasible' | 'pending'
+    delta_idx: int = -1
+    vertex_inputs: np.ndarray | None = None
+    vertex_costs: np.ndarray | None = None
+    vertex_z: np.ndarray | None = None
+    gap: float = np.inf
+    # Commutations needing a stage-2 simplex-min solve (converged nowhere).
+    pending_deltas: np.ndarray | None = None
+    # Internal: stage-1 partial gaps, completed by stage 2.
+    _stage1_gap: np.ndarray | None = None
+    _candidates: np.ndarray | None = None
+
+
+def candidate_set(sd: SimplexVertexData) -> np.ndarray:
+    """Vertex-optimal commutations, deterministic ascending order
+    (SURVEY.md section 4.1: candidate delta from vertex solutions)."""
+    ds = sd.dstar[sd.dstar >= 0]
+    return np.unique(ds)
+
+
+def best_feasible_candidate(sd: SimplexVertexData) -> int | None:
+    """Lowest-total-vertex-cost commutation among vertex-optimal candidates
+    that converged at EVERY vertex; None if there is none.  Deterministic
+    (ascending candidate order, argmin takes the first minimum) -- shared by
+    the feasibility-variant leaf rule and the depth-cap best-effort leaf so
+    backend parity cannot diverge between them."""
+    cands = candidate_set(sd)
+    cands = cands[np.all(sd.conv[:, cands], axis=0)]
+    if cands.size == 0:
+        return None
+    tot = np.array([np.sum(sd.V[:, int(d)]) for d in cands])
+    return int(cands[int(np.argmin(tot))])
+
+
+def tangent_gaps(sd: SimplexVertexData, U: np.ndarray) -> np.ndarray:
+    """gap_{delta'} = min_i max_j [U_j - l_{delta',i}(v_j)] for all delta'.
+
+    Returns (nd,); NaN where delta' converged at no vertex (stage 2 needed).
+    U is (p+1,) -- the candidate's vertex costs.
+    """
+    # tangents[i, j, d] = V[i, d] + grad[i, d] . (v_j - v_i)
+    dv = sd.verts[None, :, :] - sd.verts[:, None, :]      # (p+1, p+1, p)
+    t = sd.V[:, None, :] + np.einsum("ijk,idk->ijd", dv, sd.grad)
+    slack = U[None, :, None] - t                          # (i, j, d)
+    worst = np.max(slack, axis=1)                         # (i, d) max over j
+    worst = np.where(sd.conv, worst, np.inf)              # only valid tangents
+    gap = np.min(worst, axis=0)                           # (d,) min over i
+    none_conv = ~np.any(sd.conv, axis=0)
+    return np.where(none_conv, np.nan, gap)
+
+
+def _passes(gap: float, Vstar_verts: np.ndarray, eps_a: float,
+            eps_r: float) -> bool:
+    if eps_a > 0 and gap <= eps_a:
+        return True
+    if eps_r > 0 and gap <= eps_r * float(np.min(np.abs(Vstar_verts))):
+        return True
+    return False
+
+
+def certify_suboptimal_stage1(sd: SimplexVertexData, eps_a: float,
+                              eps_r: float) -> CertificateResult:
+    """Vertex-data-only certification attempt.
+
+    Outcomes: 'infeasible' (no commutation valid at any vertex),
+    'certified', 'split' (a candidate exists but its gap from complete
+    stage-1 information already exceeds eps), or 'pending' (gap depends on
+    commutations with no converged vertex -> stage-2 simplex-min solves).
+    """
+    feas_vertex = sd.dstar >= 0
+    if not np.any(feas_vertex):
+        return CertificateResult(status="infeasible")
+    if not np.all(feas_vertex):
+        # Mixed feasibility: the feasible/infeasible boundary crosses R.
+        return CertificateResult(status="split")
+
+    cands = candidate_set(sd)
+    # Candidates must be feasible (converged) at every vertex to define U.
+    cands = cands[np.all(sd.conv[:, cands], axis=0)]
+    if cands.size == 0:
+        return CertificateResult(status="split")
+
+    nd = sd.V.shape[1]
+    pending = np.zeros(nd, dtype=bool)
+    best = None  # (gap, delta, U)
+    stage1 = np.full((len(cands), nd), np.nan)
+    for ci, d in enumerate(cands):
+        U = sd.V[:, int(d)]
+        gaps = tangent_gaps(sd, U)
+        stage1[ci] = gaps
+        nan = np.isnan(gaps)
+        pending |= nan
+        g = np.max(np.where(nan, -np.inf, gaps))
+        if not np.any(nan):
+            if best is None or g < best[0]:
+                best = (float(g), int(d), U)
+    if not np.any(pending):
+        if best is not None and _passes(best[0], sd.Vstar, eps_a, eps_r):
+            d = best[1]
+            return CertificateResult(
+                status="certified", delta_idx=d,
+                vertex_inputs=sd.u0[:, d, :], vertex_costs=sd.V[:, d],
+                vertex_z=sd.z[:, d, :], gap=best[0])
+        return CertificateResult(status="split",
+                                 gap=best[0] if best else np.inf)
+    return CertificateResult(status="pending",
+                             pending_deltas=np.where(pending)[0],
+                             _stage1_gap=stage1, _candidates=cands)
+
+
+def certify_suboptimal_stage2(sd: SimplexVertexData, res: CertificateResult,
+                              Vmin: dict[int, float], eps_a: float,
+                              eps_r: float) -> CertificateResult:
+    """Complete a 'pending' certification with stage-2 simplex minima.
+
+    Vmin maps pending delta' -> exact min of V_delta' over R (+inf if delta'
+    infeasible on all of R; -inf if the joint solve failed, blocking
+    certification conservatively).
+    """
+    best = None
+    for ci, d in enumerate(res._candidates):
+        gaps = res._stage1_gap[ci]
+        U = sd.V[:, int(d)]
+        g = -np.inf
+        for dp in range(gaps.size):
+            if np.isnan(gaps[dp]):
+                lo = Vmin[dp]
+                gd = -np.inf if lo == np.inf else float(np.max(U) - lo)
+            else:
+                gd = gaps[dp]
+            g = max(g, gd)
+        if best is None or g < best[0]:
+            best = (float(g), int(d))
+    if best is not None and _passes(best[0], sd.Vstar, eps_a, eps_r):
+        d = best[1]
+        return CertificateResult(
+            status="certified", delta_idx=d, vertex_inputs=sd.u0[:, d, :],
+            vertex_costs=sd.V[:, d], vertex_z=sd.z[:, d, :], gap=best[0])
+    return CertificateResult(status="split", gap=best[0] if best else np.inf)
+
+
+def certify_feasible(sd: SimplexVertexData) -> CertificateResult:
+    """Feasibility-only ('feasible'/ECC) certification: a commutation
+    feasible at every vertex is feasible on all of R (convexity); the leaf
+    stores it and the online stage solves a small fixed-delta QP
+    (semi-explicit, SURVEY.md section 1 variant 'ecc' [P])."""
+    feas_vertex = sd.dstar >= 0
+    if not np.any(feas_vertex):
+        return CertificateResult(status="infeasible")
+    if not np.all(feas_vertex):
+        return CertificateResult(status="split")
+    d = best_feasible_candidate(sd)
+    if d is None:
+        return CertificateResult(status="split")
+    return CertificateResult(status="certified", delta_idx=d,
+                             vertex_inputs=sd.u0[:, d, :],
+                             vertex_costs=sd.V[:, d],
+                             vertex_z=sd.z[:, d, :], gap=0.0)

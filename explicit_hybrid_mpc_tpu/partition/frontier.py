@@ -1,0 +1,283 @@
+"""Breadth-first frontier partition engine.
+
+This is the TPU-native inversion of the reference's distributed runtime
+(SURVEY.md sections 3-4, [M-high]): where the reference runs an MPI task
+farm (scheduler rank + workers recursing depth-first with one serial Gurobi
+solve at a time), here the open leaves form a HOST-SIDE FRONTIER and each
+step issues ONE batched device program covering every unsolved vertex of
+every frontier simplex (BASELINE.json north-star: "the simplex-tree
+subdivision loop becomes a breadth-first frontier").
+
+Per step:
+  1. pop up to cfg.batch_simplices open simplices;
+  2. dedupe their vertices against the solve cache (bisection shares
+     vertices between siblings/neighbours -- caching preserves the
+     reference's work complexity);
+  3. one vmapped oracle call for all new vertices x all commutations;
+  4. host-side certificates (cheap numpy, certify.py); commutations with no
+     converged vertex trigger a second batched device call (exact simplex
+     minima / infeasibility exclusion);
+  5. converged leaves stream into the Tree; bisected children re-enter the
+     frontier.
+
+Termination: frontier empty (all leaves certified / infeasible / depth-
+capped).  The frontier + cache + tree snapshot to disk every
+cfg.checkpoint_every steps and any run can resume (SURVEY.md section 6.4).
+"""
+
+from __future__ import annotations
+
+import collections
+import pickle
+import time
+
+import numpy as np
+
+from explicit_hybrid_mpc_tpu.config import PartitionConfig
+from explicit_hybrid_mpc_tpu.oracle.oracle import Oracle, VertexSolution
+from explicit_hybrid_mpc_tpu.partition import certify, geometry
+from explicit_hybrid_mpc_tpu.partition.tree import LeafData, Tree
+from explicit_hybrid_mpc_tpu.utils.logging import RunLog
+
+
+class VertexCache:
+    """vertex -> oracle solution row, keyed by rounded coordinates."""
+
+    def __init__(self):
+        self._d: dict[bytes, tuple] = {}
+
+    def __contains__(self, v: np.ndarray) -> bool:
+        return geometry.vertex_key(v) in self._d
+
+    def get(self, v: np.ndarray) -> tuple:
+        return self._d[geometry.vertex_key(v)]
+
+    def put(self, v: np.ndarray, row: tuple) -> None:
+        self._d[geometry.vertex_key(v)] = row
+
+    def __len__(self) -> int:
+        return len(self._d)
+
+
+class PartitionResult:
+    def __init__(self, tree: Tree, roots: list[int], stats: dict):
+        self.tree = tree
+        self.roots = roots
+        self.stats = stats
+
+
+class FrontierEngine:
+    def __init__(self, problem, oracle: Oracle, cfg: PartitionConfig,
+                 log: RunLog | None = None):
+        self.problem = problem
+        self.oracle = oracle
+        self.cfg = cfg
+        self.log = log or RunLog(cfg.log_path, echo=False)
+        p = problem.n_theta
+        self.tree = Tree(p=p, n_u=problem.n_u)
+        self.roots = [self.tree.add_root(V) for V in
+                      geometry.kuhn_triangulation(problem.theta_lb,
+                                                  problem.theta_ub)]
+        self.frontier: collections.deque[int] = collections.deque(self.roots)
+        self.cache = VertexCache()
+        self.steps = 0
+        self.n_uncertified = 0
+
+    # -- vertex solves -----------------------------------------------------
+
+    def _solve_missing(self, nodes: list[int]) -> None:
+        missing: list[np.ndarray] = []
+        seen: set[bytes] = set()
+        for n in nodes:
+            for v in self.tree.vertices[n]:
+                k = geometry.vertex_key(v)
+                if k not in seen and v not in self.cache:
+                    seen.add(k)
+                    missing.append(v)
+        if not missing:
+            return
+        thetas = np.stack(missing)
+        sol = self.oracle.solve_vertices(thetas)
+        for i, v in enumerate(missing):
+            self.cache.put(v, (sol.V[i], sol.conv[i], sol.grad[i],
+                               sol.u0[i], sol.z[i], sol.Vstar[i],
+                               sol.dstar[i]))
+
+    def _vertex_data(self, node: int) -> certify.SimplexVertexData:
+        verts = self.tree.vertices[node]
+        rows = [self.cache.get(v) for v in verts]
+        return certify.SimplexVertexData(
+            verts=verts,
+            V=np.stack([r[0] for r in rows]),
+            conv=np.stack([r[1] for r in rows]),
+            grad=np.stack([r[2] for r in rows]),
+            u0=np.stack([r[3] for r in rows]),
+            z=np.stack([r[4] for r in rows]),
+            Vstar=np.array([r[5] for r in rows]),
+            dstar=np.array([r[6] for r in rows]),
+        )
+
+    # -- one frontier step -------------------------------------------------
+
+    def step(self) -> None:
+        B = min(len(self.frontier), self.cfg.batch_simplices)
+        nodes = [self.frontier.popleft() for _ in range(B)]
+        self._solve_missing(nodes)
+
+        results: dict[int, certify.CertificateResult] = {}
+        stage2: list[tuple[int, int]] = []  # (node, delta')
+        sds: dict[int, certify.SimplexVertexData] = {}
+        infeas_candidates: list[int] = []
+        for n in nodes:
+            sd = self._vertex_data(n)
+            sds[n] = sd
+            if self.cfg.algorithm == "feasible":
+                res = certify.certify_feasible(sd)
+            else:
+                res = certify.certify_suboptimal_stage1(
+                    sd, self.cfg.eps_a, self.cfg.eps_r)
+            results[n] = res
+            if res.status == "pending":
+                stage2.extend((n, int(d)) for d in res.pending_deltas)
+            elif res.status == "infeasible":
+                infeas_candidates.append(n)
+
+        if infeas_candidates:
+            # All vertices infeasible does NOT imply the simplex is (the
+            # hybrid feasible set is a union over commutations, not
+            # convex): require positive phase-1 evidence that EVERY
+            # commutation is infeasible on the whole simplex; otherwise
+            # split to hunt for the interior feasible pocket.
+            nd = self.oracle.can.n_delta
+            reqs = [(n, d) for n in infeas_candidates for d in range(nd)]
+            Ms = np.stack([geometry.barycentric_matrix(self.tree.vertices[n])
+                           for n, _ in reqs])
+            ds = np.array([d for _, d in reqs], dtype=np.int64)
+            _t, _feas, infeas_cert = self.oracle.simplex_feasibility(Ms, ds)
+            empty_on_R = collections.defaultdict(lambda: True)
+            for (n, _), ok in zip(reqs, infeas_cert):
+                empty_on_R[n] &= bool(ok)
+            for n in infeas_candidates:
+                if not empty_on_R[n]:
+                    results[n] = certify.CertificateResult(status="split")
+                # else keep 'infeasible': certified empty on R
+
+        if stage2:
+            Ms = np.stack([geometry.barycentric_matrix(self.tree.vertices[n])
+                           for n, _ in stage2])
+            ds = np.array([d for _, d in stage2], dtype=np.int64)
+            Vmin, _feas = self.oracle.solve_simplex_min(Ms, ds)
+            per_node: dict[int, dict[int, float]] = collections.defaultdict(dict)
+            for (n, d), vm in zip(stage2, Vmin):
+                per_node[n][d] = float(vm)
+            for n, vm in per_node.items():
+                results[n] = certify.certify_suboptimal_stage2(
+                    sds[n], results[n], vm, self.cfg.eps_a, self.cfg.eps_r)
+
+        n_leaves = n_splits = 0
+        for n in nodes:
+            res = results[n]
+            if res.status == "certified":
+                self.tree.set_leaf(n, LeafData(
+                    delta_idx=res.delta_idx,
+                    vertex_inputs=res.vertex_inputs,
+                    vertex_costs=res.vertex_costs,
+                    vertex_z=res.vertex_z))
+                n_leaves += 1
+            elif res.status == "infeasible":
+                pass  # leaf with no data: outside the feasible region
+            else:  # split
+                if self.tree.depth[n] >= self.cfg.max_depth:
+                    # Depth cap: accept the best available candidate as an
+                    # UNcertified best-effort leaf, flag it in stats.
+                    self.n_uncertified += 1
+                    sd = sds[n]
+                    d = certify.best_feasible_candidate(sd)
+                    if d is not None:
+                        self.tree.set_leaf(n, LeafData(
+                            delta_idx=d, vertex_inputs=sd.u0[:, d, :],
+                            vertex_costs=sd.V[:, d],
+                            vertex_z=sd.z[:, d, :]))
+                    continue
+                left, right, i, j, _ = geometry.bisect(self.tree.vertices[n])
+                li, ri = self.tree.split(n, left, right, (i, j))
+                self.frontier.append(li)
+                self.frontier.append(ri)
+                n_splits += 1
+
+        self.steps += 1
+        self.log.emit(step=self.steps, frontier=len(self.frontier),
+                      batch=B, leaves=n_leaves, splits=n_splits,
+                      regions=self.tree.n_regions(),
+                      solves=self.oracle.n_solves,
+                      cached_vertices=len(self.cache))
+
+    # -- full run ----------------------------------------------------------
+
+    def run(self) -> PartitionResult:
+        t0 = time.perf_counter()
+        while self.frontier and self.steps < self.cfg.max_steps:
+            self.step()
+            if (self.cfg.checkpoint_every
+                    and self.steps % self.cfg.checkpoint_every == 0
+                    and self.cfg.checkpoint_path):
+                self.save_checkpoint(self.cfg.checkpoint_path)
+        wall = time.perf_counter() - t0
+        stats = {
+            "regions": self.tree.n_regions(),
+            "tree_nodes": len(self.tree),
+            "max_depth": self.tree.max_depth(),
+            "steps": self.steps,
+            "oracle_solves": self.oracle.n_solves,
+            "uncertified": self.n_uncertified,
+            # Non-empty frontier here means the run hit max_steps: the
+            # remaining simplices are UNCOVERED holes, not a complete
+            # partition -- callers must check this.
+            "truncated": len(self.frontier) > 0,
+            "frontier_left": len(self.frontier),
+            "wall_s": wall,
+            "regions_per_s": self.tree.n_regions() / max(wall, 1e-9),
+        }
+        self.log.emit(done=True, **stats)
+        return PartitionResult(self.tree, self.roots, stats)
+
+    # -- checkpoint / resume (SURVEY.md section 6.4) -----------------------
+
+    def save_checkpoint(self, path: str) -> None:
+        with open(path, "wb") as f:
+            pickle.dump({
+                "tree": self.tree, "roots": self.roots,
+                "frontier": list(self.frontier),
+                "cache": self.cache._d, "steps": self.steps,
+                "n_uncertified": self.n_uncertified,
+                "n_solves": self.oracle.n_solves,
+                "cfg": self.cfg,
+            }, f, protocol=pickle.HIGHEST_PROTOCOL)
+
+    @classmethod
+    def resume(cls, path: str, problem, oracle: Oracle,
+               log: RunLog | None = None) -> "FrontierEngine":
+        with open(path, "rb") as f:
+            snap = pickle.load(f)
+        eng = cls.__new__(cls)
+        eng.problem = problem
+        eng.oracle = oracle
+        eng.cfg = snap["cfg"]
+        eng.log = log or RunLog(eng.cfg.log_path, echo=False)
+        eng.tree = snap["tree"]
+        eng.roots = snap["roots"]
+        eng.frontier = collections.deque(snap["frontier"])
+        eng.cache = VertexCache()
+        eng.cache._d = snap["cache"]
+        eng.steps = snap["steps"]
+        eng.n_uncertified = snap["n_uncertified"]
+        oracle.n_solves = snap.get("n_solves", 0)
+        return eng
+
+
+def build_partition(problem, cfg: PartitionConfig,
+                    oracle: Oracle | None = None) -> PartitionResult:
+    """One-call offline build: problem + config -> certified partition."""
+    oracle = oracle or Oracle(problem, backend=cfg.backend)
+    log = RunLog(cfg.log_path, echo=False)
+    return FrontierEngine(problem, oracle, cfg, log).run()

@@ -1,0 +1,136 @@
+"""Simplex geometry for the partition engine.
+
+Host-side (numpy) counterparts of the reference's geometry helpers
+(SURVEY.md section 3 "Geometry & misc tools", [M-med], UNVERIFIED --
+reference mount empty): triangulation of the parameter box into root
+simplices, barycentric coordinates, longest-edge bisection, volumes.
+
+Everything here is deterministic (lexicographic tie-breaks) because region-
+count parity between the serial-CPU and TPU oracle paths requires identical
+subdivision decisions (BASELINE.json north-star).
+
+A simplex in R^p is stored as a vertex matrix ``V`` of shape (p+1, p).
+"""
+
+from __future__ import annotations
+
+import itertools
+import math
+
+import numpy as np
+
+
+def kuhn_triangulation(lb: np.ndarray, ub: np.ndarray) -> np.ndarray:
+    """Triangulate the box [lb, ub] into p! simplices (Kuhn/Freudenthal).
+
+    Each permutation ``pi`` of (0..p-1) yields the simplex with vertices
+    ``v_0 = lb``, ``v_{k+1} = v_k + (ub-lb)[pi[k]] * e_{pi[k]}``.  The union
+    covers the box exactly, interiors are disjoint, and the construction is
+    deterministic -- unlike Delaunay of the 2^p corners, it needs no Qhull
+    and is stable in any dimension.  Returns (p!, p+1, p).
+
+    The reference Delaunay-triangulates the parameter box into root
+    simplices (SURVEY.md section 1 step 1, [P]); Kuhn gives the same cover
+    with a reproducible simplex set.
+    """
+    lb = np.asarray(lb, dtype=np.float64)
+    ub = np.asarray(ub, dtype=np.float64)
+    if lb.shape != ub.shape or lb.ndim != 1:
+        raise ValueError("lb/ub must be 1-D with equal shapes")
+    if not np.all(ub > lb):
+        raise ValueError("need ub > lb elementwise")
+    p = lb.size
+    if p > 7:
+        raise ValueError(
+            f"Kuhn triangulation of a {p}-D box has {math.factorial(p)} "
+            "root simplices; partition over a lower-dimensional parameter "
+            "subspace instead (see problems.base.ParameterMap)"
+        )
+    edges = ub - lb
+    sims = []
+    for pi in itertools.permutations(range(p)):
+        verts = np.empty((p + 1, p), dtype=np.float64)
+        verts[0] = lb
+        for k, axis in enumerate(pi):
+            verts[k + 1] = verts[k]
+            verts[k + 1, axis] += edges[axis]
+        sims.append(verts)
+    return np.stack(sims)
+
+
+def barycentric_matrix(V: np.ndarray) -> np.ndarray:
+    """Matrix M with lambda = M @ [theta; 1] the barycentric coordinates.
+
+    V is (p+1, p).  Solves [V^T; 1^T] lambda = [theta; 1]; M is the inverse
+    of that (p+1)x(p+1) system, precomputed per leaf for the online
+    evaluator (SURVEY.md section 4.2).
+    """
+    p = V.shape[1]
+    A = np.vstack([V.T, np.ones((1, p + 1))])
+    return np.linalg.inv(A)
+
+
+def barycentric(V: np.ndarray, theta: np.ndarray) -> np.ndarray:
+    """Barycentric coordinates of theta w.r.t. simplex V ((p+1,p))."""
+    M = barycentric_matrix(V)
+    return M @ np.concatenate([theta, [1.0]])
+
+
+def contains(V: np.ndarray, theta: np.ndarray, tol: float = 1e-9) -> bool:
+    """Point-in-simplex test via barycentric nonnegativity."""
+    lam = barycentric(V, theta)
+    return bool(np.all(lam >= -tol))
+
+
+def simplex_volume(V: np.ndarray) -> float:
+    """Volume of the simplex with vertex matrix V ((p+1, p))."""
+    p = V.shape[1]
+    D = V[1:] - V[0]
+    return float(abs(np.linalg.det(D)) / math.factorial(p))
+
+
+def longest_edge(V: np.ndarray) -> tuple[int, int]:
+    """Indices (i, j), i < j, of the longest edge; lexicographic tie-break.
+
+    The subdivision step bisects this edge (SURVEY.md section 1 step 2c,
+    [P]/[NS]: "longest-edge bisection").  Tie-break must be deterministic
+    for backend-parity of the produced tree.
+    """
+    n = V.shape[0]
+    best = (-1.0, 0, 1)
+    for i in range(n):
+        for j in range(i + 1, n):
+            d = float(np.dot(V[i] - V[j], V[i] - V[j]))
+            # Strict > keeps the lexicographically first pair on ties.
+            if d > best[0] + 1e-15:
+                best = (d, i, j)
+    return best[1], best[2]
+
+
+def bisect(V: np.ndarray) -> tuple[np.ndarray, np.ndarray, int, int, np.ndarray]:
+    """Longest-edge bisection: split V into two children.
+
+    Returns (child_left, child_right, i, j, midpoint) where the split edge
+    is (i, j) and each child replaces one endpoint with the midpoint.  The
+    children cover V exactly with disjoint interiors; repeated longest-edge
+    bisection keeps simplices shape-regular (Rivara).
+    """
+    i, j = longest_edge(V)
+    mid = 0.5 * (V[i] + V[j])
+    left = V.copy()
+    left[j] = mid
+    right = V.copy()
+    right[i] = mid
+    return left, right, i, j, mid
+
+
+def vertex_key(v: np.ndarray, decimals: int = 9) -> bytes:
+    """Hashable key for a vertex, for the solve cache.
+
+    Bisection midpoints are shared by siblings and by neighbouring
+    simplices; caching per-vertex oracle solutions reproduces the
+    reference's work complexity (SURVEY.md section 8 layer 3, "vertex-solve
+    caching").  Rounding makes keys stable under the exact-midpoint
+    arithmetic used here (midpoints are computed identically everywhere).
+    """
+    return np.round(np.asarray(v, dtype=np.float64), decimals).tobytes()

@@ -1,0 +1,152 @@
+"""Host-side simplex tree.
+
+Counterpart of the reference's binary simplex tree (``Tree``/``NodeData``,
+SURVEY.md section 3 [M-high]; citation UNVERIFIED -- reference mount empty):
+node = vertex matrix + commutation + vertex inputs/costs; grows by
+longest-edge bisection; serializes to disk.
+
+Flat-array storage instead of linked Python objects: nodes live in growable
+numpy arrays so that (a) serialization is trivial and fast, (b) exporting
+leaves for the on-device online evaluator (online/export.py) is a slice, not
+a traversal, and (c) memory stays compact for >10^5-region partitions.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import pickle
+from typing import Optional
+
+import numpy as np
+
+NO_CHILD = -1
+
+
+@dataclasses.dataclass
+class LeafData:
+    """Payload of a converged leaf.
+
+    delta_idx indexes the problem's commutation enumeration (-1 for pure
+    mp-QP problems with a single implicit commutation).  vertex_inputs is
+    (p+1, n_u): the first control move at each vertex; the online law is
+    their barycentric interpolation (SURVEY.md section 4.2).  vertex_costs
+    is (p+1,): the fixed-commutation optimal cost at each vertex.
+    """
+
+    delta_idx: int
+    vertex_inputs: np.ndarray
+    vertex_costs: np.ndarray
+    # Full primal sequences at the vertices (p+1, nz): their barycentric
+    # interpolation is the certified feasible, eps-suboptimal input sequence.
+    vertex_z: np.ndarray | None = None
+
+
+class Tree:
+    """Binary simplex tree over the parameter set Theta.
+
+    Roots are the Kuhn triangulation of the Theta box; every internal node
+    has exactly two children from longest-edge bisection.
+    """
+
+    def __init__(self, p: int, n_u: int):
+        self.p = p
+        self.n_u = n_u
+        self.vertices: list[np.ndarray] = []  # per node: (p+1, p)
+        self.parent: list[int] = []
+        self.children: list[tuple[int, int]] = []  # (NO_CHILD, NO_CHILD) = leaf
+        self.depth: list[int] = []
+        # Split metadata (for tree-descent online eval): which edge (i, j)
+        # of this node's simplex was bisected.
+        self.split_edge: list[tuple[int, int]] = []
+        self.leaf_data: list[Optional[LeafData]] = []
+
+    # -- construction ------------------------------------------------------
+
+    def add_root(self, V: np.ndarray) -> int:
+        return self._add(V, parent=-1, depth=0)
+
+    def _add(self, V: np.ndarray, parent: int, depth: int) -> int:
+        assert V.shape == (self.p + 1, self.p)
+        self.vertices.append(np.asarray(V, dtype=np.float64))
+        self.parent.append(parent)
+        self.children.append((NO_CHILD, NO_CHILD))
+        self.depth.append(depth)
+        self.split_edge.append((-1, -1))
+        self.leaf_data.append(None)
+        return len(self.vertices) - 1
+
+    def split(self, node: int, left_V: np.ndarray, right_V: np.ndarray,
+              edge: tuple[int, int]) -> tuple[int, int]:
+        """Attach the two bisection children of `node`."""
+        assert self.children[node] == (NO_CHILD, NO_CHILD)
+        d = self.depth[node] + 1
+        li = self._add(left_V, node, d)
+        ri = self._add(right_V, node, d)
+        self.children[node] = (li, ri)
+        self.split_edge[node] = edge
+        return li, ri
+
+    def set_leaf(self, node: int, data: LeafData) -> None:
+        assert self.children[node] == (NO_CHILD, NO_CHILD)
+        self.leaf_data[node] = data
+
+    # -- queries -----------------------------------------------------------
+
+    def __len__(self) -> int:
+        return len(self.vertices)
+
+    def is_leaf(self, node: int) -> bool:
+        return self.children[node] == (NO_CHILD, NO_CHILD)
+
+    def leaves(self) -> list[int]:
+        return [i for i in range(len(self)) if self.is_leaf(i)]
+
+    def converged_leaves(self) -> list[int]:
+        return [i for i in self.leaves() if self.leaf_data[i] is not None]
+
+    def n_regions(self) -> int:
+        return len(self.converged_leaves())
+
+    def max_depth(self) -> int:
+        return max(self.depth) if self.depth else 0
+
+    def locate(self, theta: np.ndarray, roots: list[int],
+               tol: float = 1e-9) -> int:
+        """Tree descent: leaf whose simplex contains theta (-1 if outside).
+
+        The reference's online point location (SURVEY.md section 4.2 [P]):
+        pick the containing root, then at each internal node descend into
+        the child containing theta.  O(depth) barycentric tests.
+        """
+        from explicit_hybrid_mpc_tpu.partition import geometry
+
+        node = -1
+        for r in roots:
+            if geometry.contains(self.vertices[r], theta, tol):
+                node = r
+                break
+        if node < 0:
+            return -1
+        while not self.is_leaf(node):
+            li, ri = self.children[node]
+            if geometry.contains(self.vertices[li], theta, tol):
+                node = li
+            else:
+                node = ri
+        return node
+
+    # -- serialization -----------------------------------------------------
+
+    def save(self, path: str) -> None:
+        """Pickle to disk (the reference pickles its tree; SURVEY.md
+        section 3 [M-high], UNVERIFIED)."""
+        with open(path, "wb") as f:
+            pickle.dump(self, f, protocol=pickle.HIGHEST_PROTOCOL)
+
+    @staticmethod
+    def load(path: str) -> "Tree":
+        with open(path, "rb") as f:
+            tree = pickle.load(f)
+        if not isinstance(tree, Tree):
+            raise TypeError(f"{path} does not contain a Tree")
+        return tree

@@ -1,0 +1,33 @@
+"""Structured JSONL metrics (SURVEY.md section 6.5 build obligation).
+
+The reference prints progress/ETA to stdout and pickles statistics
+[M-med]; here every frontier step emits one JSON line so runs are machine-
+readable (regions/sec is the north-star metric)."""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from typing import IO, Optional
+
+
+class RunLog:
+    def __init__(self, path: Optional[str] = None, echo: bool = True):
+        self._fh: Optional[IO[str]] = open(path, "a") if path else None
+        self._echo = echo
+        self.t0 = time.perf_counter()
+
+    def emit(self, **fields) -> None:
+        rec = {"t": round(time.perf_counter() - self.t0, 4), **fields}
+        line = json.dumps(rec)
+        if self._fh:
+            self._fh.write(line + "\n")
+            self._fh.flush()
+        if self._echo:
+            print(line, file=sys.stderr)
+
+    def close(self) -> None:
+        if self._fh:
+            self._fh.close()
+            self._fh = None

@@ -1,0 +1,22 @@
+"""Test environment: CPU platform with 8 virtual devices.
+
+Mesh/sharding logic is tested without a TPU via XLA's host-platform device
+splitting (SURVEY.md section 5: "multi-device tests via jax CPU-device
+simulation").  Must run before jax is imported anywhere.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def rng():
+    return np.random.default_rng(1234)

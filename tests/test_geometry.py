@@ -1,0 +1,57 @@
+import math
+
+import numpy as np
+import pytest
+
+from explicit_hybrid_mpc_tpu.partition import geometry as g
+
+
+def test_kuhn_covers_box(rng):
+    for p in (1, 2, 3, 4):
+        lb, ub = -np.ones(p), 2 * np.ones(p)
+        T = g.kuhn_triangulation(lb, ub)
+        assert T.shape == (math.factorial(p), p + 1, p)
+        vol = sum(g.simplex_volume(V) for V in T)
+        assert np.isclose(vol, 3.0 ** p)
+        # Random points lie in >= 1 simplex; interior points in exactly 1.
+        pts = rng.uniform(lb, ub, size=(50, p))
+        for x in pts:
+            hits = sum(g.contains(V, x, tol=1e-12) for V in T)
+            assert hits >= 1
+
+
+def test_barycentric_roundtrip(rng):
+    V = rng.normal(size=(4, 3))
+    lam = rng.dirichlet(np.ones(4))
+    theta = lam @ V
+    lam2 = g.barycentric(V, theta)
+    np.testing.assert_allclose(lam, lam2, atol=1e-10)
+    assert g.contains(V, theta)
+    assert not g.contains(V, V.mean(axis=0) + 100.0)
+
+
+def test_bisect_preserves_volume(rng):
+    V = rng.normal(size=(5, 4))
+    left, right, i, j, mid = g.bisect(V)
+    np.testing.assert_allclose(mid, 0.5 * (V[i] + V[j]))
+    assert np.isclose(g.simplex_volume(left) + g.simplex_volume(right),
+                      g.simplex_volume(V))
+    # Children partition the parent: sampled interior points fall in one.
+    for _ in range(20):
+        lam = rng.dirichlet(np.ones(5))
+        x = lam @ V
+        assert g.contains(left, x, 1e-9) or g.contains(right, x, 1e-9)
+
+
+def test_longest_edge_deterministic():
+    V = np.array([[0.0, 0.0], [1.0, 0.0], [0.0, 1.0]])
+    # Edges (0,1) and (0,2) tie at length 1; (1,2) is longest (sqrt 2).
+    assert g.longest_edge(V) == (1, 2)
+    # Equilateral-ish tie: lexicographic first.
+    V2 = np.array([[0.0, 0.0], [1.0, 0.0], [0.5, np.sqrt(3) / 2]])
+    assert g.longest_edge(V2) == (0, 1)
+
+
+def test_kuhn_rejects_high_dim():
+    with pytest.raises(ValueError):
+        g.kuhn_triangulation(-np.ones(9), np.ones(9))

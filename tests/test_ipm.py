@@ -1,0 +1,85 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+from scipy.optimize import minimize
+
+import explicit_hybrid_mpc_tpu  # noqa: F401  (enables x64)
+from explicit_hybrid_mpc_tpu.oracle import ipm
+
+
+def _scipy_qp(Q, q, A, b):
+    n = Q.shape[0]
+    res = minimize(
+        lambda z: 0.5 * z @ Q @ z + q @ z, np.zeros(n),
+        jac=lambda z: Q @ z + q, method="SLSQP",
+        constraints=[{"type": "ineq", "fun": lambda z: b - A @ z,
+                      "jac": lambda z: -A}],
+        options={"ftol": 1e-12, "maxiter": 300})
+    assert res.success
+    return res.x, res.fun
+
+
+def test_box_projection_analytic(rng):
+    n = 6
+    Q = jnp.eye(n)
+    A = jnp.concatenate([jnp.eye(n), -jnp.eye(n)])
+    b = jnp.ones(2 * n)
+    a = rng.normal(size=(32, n)) * 2.0
+    sol = jax.jit(jax.vmap(lambda q: ipm.qp_solve(Q, q, A, b)))(jnp.asarray(-a))
+    np.testing.assert_allclose(np.asarray(sol.z), np.clip(a, -1, 1),
+                               atol=1e-8)
+    assert bool(np.all(sol.converged))
+
+
+def test_random_qp_matches_scipy(rng):
+    for n, m in [(3, 5), (8, 20), (15, 40)]:
+        M = rng.normal(size=(n, n))
+        Q = M @ M.T + np.eye(n)
+        q = rng.normal(size=n)
+        A = rng.normal(size=(m, n))
+        b = rng.normal(size=m) + 1.0  # z=0 strictly feasible
+        sol = ipm.qp_solve(jnp.asarray(Q), jnp.asarray(q), jnp.asarray(A),
+                           jnp.asarray(b))
+        z_ref, f_ref = _scipy_qp(Q, q, A, b)
+        assert bool(sol.converged)
+        assert abs(float(sol.obj) - f_ref) < 1e-6 * (1 + abs(f_ref))
+        np.testing.assert_allclose(np.asarray(sol.z), z_ref, atol=1e-5)
+
+
+def test_active_constraint_duals(rng):
+    # min 1/2 z^2 - z  s.t. z <= 0  ->  z*=0, lam*=1 (dual of the bound).
+    sol = ipm.qp_solve(jnp.eye(1), -jnp.ones(1), jnp.ones((1, 1)),
+                       jnp.zeros(1))
+    assert abs(float(sol.z[0])) < 1e-8
+    assert abs(float(sol.lam[0]) - 1.0) < 1e-6
+
+
+def test_infeasible_detected():
+    A = jnp.array([[1.0], [-1.0]])
+    b = jnp.array([-1.0, -1.0])  # z <= -1 and z >= 1: empty
+    sol = ipm.qp_solve(jnp.eye(1), jnp.zeros(1), A, b)
+    assert not bool(sol.feasible)
+    assert not bool(sol.converged)
+
+
+def test_phase1_sign():
+    A = jnp.array([[1.0], [-1.0]])
+    t_inf = ipm.phase1(A, jnp.array([-1.0, -1.0]))   # empty set
+    t_feas = ipm.phase1(A, jnp.array([1.0, 1.0]))    # [-1, 1]
+    assert float(t_inf) > 0.5
+    assert float(t_feas) < -0.5
+
+
+def test_degenerate_equality_like(rng):
+    # Paired inequalities pin z1 = 0.3 exactly (empty interior): the IPM
+    # must still converge (infeasible-start handles degenerate geometry).
+    n = 3
+    Q = jnp.eye(n)
+    q = jnp.asarray(rng.normal(size=n))
+    e = np.zeros((1, n)); e[0, 0] = 1.0
+    A = jnp.asarray(np.vstack([e, -e]))
+    b = jnp.asarray(np.array([0.3, -0.3]))
+    sol = ipm.qp_solve(Q, q, A, b, n_iter=50)
+    assert abs(float(sol.z[0]) - 0.3) < 1e-6
+    np.testing.assert_allclose(np.asarray(sol.z[1:]),
+                               -np.asarray(q)[1:], atol=1e-6)

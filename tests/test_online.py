@@ -1,0 +1,64 @@
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from explicit_hybrid_mpc_tpu.config import PartitionConfig
+from explicit_hybrid_mpc_tpu.online import evaluator, export
+from explicit_hybrid_mpc_tpu.partition import geometry
+from explicit_hybrid_mpc_tpu.partition.frontier import build_partition
+from explicit_hybrid_mpc_tpu.problems.registry import make
+
+
+@pytest.fixture(scope="module")
+def built():
+    prob = make("double_integrator", N=3, theta_box=1.5)
+    cfg = PartitionConfig(problem="double_integrator", eps_a=0.5,
+                          backend="cpu", batch_simplices=64, max_depth=20)
+    res = build_partition(prob, cfg)
+    table = export.export_leaves(res.tree)
+    return prob, res, table
+
+
+def test_export_shapes(built):
+    prob, res, table = built
+    L = table.n_leaves
+    assert L == res.stats["regions"]
+    assert table.bary_M.shape == (L, 3, 3)
+    assert table.U.shape == (L, 3, 1)
+
+
+def test_device_eval_matches_tree_descent(built, rng):
+    prob, res, table = built
+    dev = evaluator.stage(table)
+    thetas = rng.uniform(prob.theta_lb, prob.theta_ub, size=(64, 2))
+    out = evaluator.evaluate(dev, jnp.asarray(thetas))
+    assert bool(np.all(np.asarray(out.inside)))
+    for k, th in enumerate(thetas):
+        n = res.tree.locate(th, res.roots)
+        lam = geometry.barycentric(res.tree.vertices[n], th)
+        u_ref = res.tree.leaf_data[n].vertex_inputs.T @ lam
+        # Shared facets can give two containing leaves; compare values, not
+        # leaf ids.
+        np.testing.assert_allclose(np.asarray(out.u[k]), u_ref, atol=1e-6)
+        u_np = evaluator.evaluate_np(table, th)
+        np.testing.assert_allclose(u_np, u_ref, atol=1e-6)
+
+
+def test_outside_flagged(built):
+    prob, res, table = built
+    dev = evaluator.stage(table)
+    out = evaluator.evaluate(dev, jnp.asarray([[10.0, 10.0]]))
+    assert not bool(out.inside[0])
+
+
+def test_controller_is_continuous_across_facets(built, rng):
+    """PWA law from barycentric interpolation is continuous: evaluate at
+    points straddling internal facets."""
+    prob, res, table = built
+    dev = evaluator.stage(table)
+    for _ in range(10):
+        th = rng.uniform(prob.theta_lb * 0.9, prob.theta_ub * 0.9)
+        eps_step = 1e-7 * rng.normal(size=2)
+        pair = jnp.asarray(np.stack([th, th + eps_step]))
+        out = evaluator.evaluate(dev, pair)
+        assert abs(float(out.u[0, 0]) - float(out.u[1, 0])) < 1e-4

@@ -1,0 +1,116 @@
+"""Oracle plugin-boundary tests: pointwise enumeration, feasibility
+queries, simplex-min bounds, backend equivalence."""
+
+import numpy as np
+import pytest
+
+from explicit_hybrid_mpc_tpu.config import PartitionConfig
+from explicit_hybrid_mpc_tpu.oracle.oracle import Oracle
+from explicit_hybrid_mpc_tpu.partition import geometry
+from explicit_hybrid_mpc_tpu.problems import base
+from explicit_hybrid_mpc_tpu.problems.registry import make
+
+
+@pytest.fixture(scope="module")
+def di():
+    return make("double_integrator", N=3, theta_box=1.5)
+
+
+@pytest.fixture(scope="module")
+def oracle(di):
+    return Oracle(di, backend="cpu")
+
+
+def test_vertex_solutions_consistent(oracle, di, rng):
+    thetas = rng.uniform(di.theta_lb, di.theta_ub, size=(16, 2))
+    sol = oracle.solve_vertices(thetas)
+    assert np.all(sol.dstar == 0)  # single commutation
+    assert np.all(np.isfinite(sol.Vstar))
+    # V* must equal the per-delta V at dstar.
+    np.testing.assert_allclose(sol.Vstar, sol.V[:, 0])
+    # Value function gradient check by finite differences.
+    h = 1e-5
+    for k in range(4):
+        th = thetas[k]
+        for ax in range(2):
+            e = np.zeros(2)
+            e[ax] = h
+            Vp = oracle.solve_vertices((th + e)[None]).Vstar[0]
+            Vm = oracle.solve_vertices((th - e)[None]).Vstar[0]
+            fd = (Vp - Vm) / (2 * h)
+            assert abs(fd - sol.grad[k, 0, ax]) < 1e-4 * (1 + abs(fd))
+
+
+def test_point_feasibility_signs(oracle):
+    t = oracle.feasibility(np.array([[0.0, 0.0], [80.0, 80.0]]),
+                           np.array([0, 0]))
+    assert t[0] <= 1e-8
+    assert t[1] > 1.0
+
+
+def test_simplex_feasibility_farkas(oracle):
+    V_in = np.array([[0.0, 0.0], [0.5, 0.0], [0.0, 0.5]])
+    V_out = V_in + 60.0  # far outside the reachable/constraint set
+    Ms = np.stack([geometry.barycentric_matrix(V) for V in (V_in, V_out)])
+    t, feas_somewhere, infeas_cert = oracle.simplex_feasibility(
+        Ms, np.array([0, 0]))
+    assert feas_somewhere[0] and not infeas_cert[0]
+    assert infeas_cert[1] and not feas_somewhere[1]
+
+
+def test_simplex_min_matches_vertex_min(oracle, di):
+    """Exact simplex min must lower-bound (and for a tiny simplex approach)
+    the vertex values."""
+    V = np.array([[0.1, 0.1], [0.2, 0.1], [0.1, 0.2]])
+    M = geometry.barycentric_matrix(V)[None]
+    Vmin, feas = oracle.solve_simplex_min(M, np.array([0]))
+    vert = oracle.solve_vertices(V)
+    assert feas[0]
+    assert Vmin[0] <= np.min(vert.Vstar) + 1e-6
+    assert Vmin[0] > 0.0  # cost is PD quadratic-ish, away from origin
+
+
+class _Unconstrained(base.HybridMPC):
+    """Zero-constraint problem: stack_slices must pad to nc=1 and the IPM
+    must solve it exactly (review finding: zero-row crash)."""
+
+    name = "_unconstrained"
+
+    def __init__(self):
+        self.theta_lb = -np.ones(2)
+        self.theta_ub = np.ones(2)
+        self.n_u = 1
+
+    def build_canonical(self):
+        A = np.array([[1.0, 0.1], [0.0, 1.0]])
+        B = np.array([[0.0], [0.1]])
+        sl = base.condense(
+            A_seq=[A] * 3, B_seq=[B] * 3, e_seq=[np.zeros(2)] * 3,
+            Q=np.eye(2), R=np.eye(1), P=np.eye(2), E=np.eye(2),
+            x_nom=np.zeros(2), n_u=1)
+        return base.stack_slices([sl], deltas=np.zeros((1, 0), np.int64))
+
+
+def test_zero_constraint_problem_solvable(rng):
+    prob = _Unconstrained()
+    can = prob.canonical
+    assert can.nc == 1  # vacuous padding row
+    o = Oracle(prob, backend="cpu")
+    sol = o.solve_vertices(rng.uniform(-1, 1, size=(4, 2)))
+    assert np.all(sol.conv)
+    # Unconstrained optimum: z* = -H^{-1} (f + F theta).
+    th = np.array([0.3, -0.2])
+    sol1 = o.solve_vertices(th[None])
+    z_exact = -np.linalg.solve(can.H[0], can.f[0] + can.F[0] @ th)
+    np.testing.assert_allclose(sol1.z[0, 0], z_exact, atol=1e-7)
+
+
+def test_truncated_run_reported():
+    from explicit_hybrid_mpc_tpu.partition.frontier import build_partition
+
+    prob = make("double_integrator", N=3, theta_box=1.5)
+    cfg = PartitionConfig(eps_a=0.01, backend="cpu", batch_simplices=8,
+                          max_steps=3)
+    res = build_partition(prob, cfg)
+    assert res.stats["truncated"]
+    assert res.stats["frontier_left"] > 0

@@ -1,0 +1,119 @@
+"""Partition engine tests: termination, coverage, and the central property
+-- every certified leaf's law is eps-suboptimal and feasible at sampled
+interior points (SURVEY.md section 5: "leaf certificate => sampled thetas
+satisfy eps-suboptimality vs a reference solver")."""
+
+import os
+
+import numpy as np
+import pytest
+
+from explicit_hybrid_mpc_tpu.config import PartitionConfig
+from explicit_hybrid_mpc_tpu.oracle.oracle import Oracle
+from explicit_hybrid_mpc_tpu.partition import geometry
+from explicit_hybrid_mpc_tpu.partition.frontier import (FrontierEngine,
+                                                        build_partition)
+from explicit_hybrid_mpc_tpu.problems.registry import make
+from explicit_hybrid_mpc_tpu.utils.logging import RunLog
+
+EPS = 0.5
+
+
+@pytest.fixture(scope="module")
+def di_partition():
+    prob = make("double_integrator", N=3, theta_box=1.5)
+    cfg = PartitionConfig(problem="double_integrator", eps_a=EPS,
+                          backend="cpu", batch_simplices=64, max_depth=20)
+    res = build_partition(prob, cfg)
+    return prob, cfg, res
+
+
+def test_terminates_all_certified(di_partition):
+    prob, cfg, res = di_partition
+    assert res.stats["uncertified"] == 0
+    assert res.stats["regions"] > 10
+    assert res.stats["regions"] == res.tree.n_regions()
+
+
+def test_coverage_and_disjointness(di_partition, rng):
+    prob, cfg, res = di_partition
+    tree = res.tree
+    leaves = tree.converged_leaves()
+    vols = sum(geometry.simplex_volume(tree.vertices[n]) for n in leaves)
+    box_vol = float(np.prod(prob.theta_ub - prob.theta_lb))
+    assert np.isclose(vols, box_vol, rtol=1e-9)
+    # Interior sample points: located leaf contains them.
+    for _ in range(30):
+        th = rng.uniform(prob.theta_lb, prob.theta_ub)
+        n = tree.locate(th, res.roots)
+        assert n >= 0 and tree.leaf_data[n] is not None
+        assert geometry.contains(tree.vertices[n], th, tol=1e-9)
+
+
+def test_eps_suboptimality_property(di_partition, rng):
+    """The certified guarantee: the interpolated full input sequence is
+    feasible and its cost is within eps_a of V*(theta)."""
+    prob, cfg, res = di_partition
+    tree = res.tree
+    can = prob.canonical
+    oracle = Oracle(prob, backend="cpu")
+    thetas = rng.uniform(prob.theta_lb, prob.theta_ub, size=(40, 2))
+    sol = oracle.solve_vertices(thetas)
+    for k, th in enumerate(thetas):
+        n = tree.locate(th, res.roots)
+        ld = tree.leaf_data[n]
+        d = max(ld.delta_idx, 0)
+        lam = geometry.barycentric(tree.vertices[n], th)
+        zbar = lam @ ld.vertex_z
+        # Feasibility of the interpolated sequence.
+        viol = np.max(can.G[d] @ zbar - can.w[d] - can.S[d] @ th)
+        assert viol <= 1e-6, f"theta {th}: violation {viol}"
+        # eps-suboptimality vs the enumerated optimum.
+        J = can.value(d, th, zbar)
+        assert J <= sol.Vstar[k] + EPS + 1e-6, (
+            f"theta {th}: J={J} V*={sol.Vstar[k]}")
+
+
+def test_vertex_cache_shares_work():
+    prob = make("double_integrator", N=3, theta_box=1.5)
+    cfg = PartitionConfig(problem="double_integrator", eps_a=EPS,
+                          backend="cpu", batch_simplices=64, max_depth=20)
+    oracle = Oracle(prob, backend="cpu")
+    eng = FrontierEngine(prob, oracle, cfg)
+    res = eng.run()
+    # Far fewer unique vertex solves than (p+1) per processed simplex.
+    processed = res.stats["tree_nodes"]
+    assert len(eng.cache) < 0.8 * processed * 3
+
+
+def test_checkpoint_resume(tmp_path):
+    prob = make("double_integrator", N=3, theta_box=1.5)
+    cfg = PartitionConfig(problem="double_integrator", eps_a=EPS,
+                          backend="cpu", batch_simplices=16, max_depth=20)
+    oracle = Oracle(prob, backend="cpu")
+    eng = FrontierEngine(prob, oracle, cfg)
+    for _ in range(3):
+        eng.step()
+    ckpt = os.path.join(tmp_path, "snap.pkl")
+    eng.save_checkpoint(ckpt)
+    # Finish the original.
+    res_full = eng.run()
+    # Resume from snapshot and finish independently.
+    eng2 = FrontierEngine.resume(ckpt, prob, Oracle(prob, backend="cpu"))
+    res_resumed = eng2.run()
+    assert res_resumed.stats["regions"] == res_full.stats["regions"]
+    assert res_resumed.tree.max_depth() == res_full.tree.max_depth()
+
+
+def test_serial_vs_batched_region_parity():
+    """North-star requirement: identical region count between the serial
+    oracle baseline and the batched backend (BASELINE.json)."""
+    prob = make("double_integrator", N=3, theta_box=1.5)
+    counts = {}
+    for backend in ("serial", "cpu"):
+        cfg = PartitionConfig(problem="double_integrator", eps_a=EPS,
+                              backend=backend, batch_simplices=32,
+                              max_depth=20)
+        res = build_partition(prob, cfg, Oracle(prob, backend=backend))
+        counts[backend] = (res.stats["regions"], res.stats["tree_nodes"])
+    assert counts["serial"] == counts["cpu"]

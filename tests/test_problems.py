@@ -1,0 +1,92 @@
+import numpy as np
+import pytest
+
+from explicit_hybrid_mpc_tpu.problems import base
+from explicit_hybrid_mpc_tpu.problems.registry import make, names
+
+
+def test_registry():
+    assert "double_integrator" in names()
+    assert "mass_spring" in names()
+    with pytest.raises(KeyError):
+        make("nope")
+
+
+def _rollout_cost(A, B, Q, R, P, x0, us):
+    """Brute-force simulation of the MPC objective, independent of
+    condense()'s prediction-matrix algebra."""
+    x = x0.copy()
+    J = 0.0
+    for u in us:
+        J += 0.5 * x @ Q @ x + 0.5 * u @ R @ u
+        x = A @ x + B @ u
+    return J + 0.5 * x @ P @ x, x
+
+
+def test_condense_matches_rollout(rng):
+    n, m, N = 3, 2, 4
+    A = rng.normal(size=(n, n)) * 0.4 + np.eye(n)
+    B = rng.normal(size=(n, m))
+    Q = np.eye(n)
+    R = np.eye(m) * 0.5
+    P = np.eye(n) * 2.0
+    sl = base.condense(
+        A_seq=[A] * N, B_seq=[B] * N, e_seq=[np.zeros(n)] * N,
+        Q=Q, R=R, P=P, E=np.eye(n), x_nom=np.zeros(n), n_u=m,
+    )
+    for _ in range(10):
+        theta = rng.normal(size=n)
+        z = rng.normal(size=N * m)
+        us = z.reshape(N, m)
+        J_roll, _ = _rollout_cost(A, B, Q, R, P, theta, us)
+        J_can = (0.5 * z @ sl.H @ z + (sl.f + sl.F @ theta) @ z
+                 + 0.5 * theta @ sl.Y @ theta + sl.pvec @ theta + sl.cconst)
+        assert np.isclose(J_roll, J_can, rtol=1e-10, atol=1e-10)
+
+
+def test_condense_constraints_match_rollout(rng):
+    n, m, N = 2, 1, 3
+    A = np.array([[1.0, 0.1], [0.0, 1.0]])
+    B = np.array([[0.0], [0.1]])
+    Cx, cx = base.box_rows(-np.ones(n), np.ones(n))
+    Cu, cu = base.box_rows(-np.ones(m), np.ones(m))
+    sl = base.condense(
+        A_seq=[A] * N, B_seq=[B] * N, e_seq=[np.zeros(n)] * N,
+        Q=np.eye(n), R=np.eye(m), P=np.eye(n), E=np.eye(n),
+        x_nom=np.zeros(n), n_u=m,
+        state_con=[(Cx, cx)] * N, input_con=[(Cu, cu)] * N,
+    )
+    for _ in range(20):
+        theta = rng.uniform(-1, 1, size=n)
+        z = rng.uniform(-1.5, 1.5, size=N * m)
+        # Constraint satisfaction via canonical rows...
+        can_ok = np.all(sl.G @ z <= sl.w + sl.S @ theta + 1e-12)
+        # ...equals constraint satisfaction via rollout.
+        x = theta.copy()
+        roll_ok = True
+        for k in range(N):
+            u = z[k * m:(k + 1) * m]
+            roll_ok &= bool(np.all(np.abs(u) <= 1 + 1e-12))
+            x = A @ x + B @ u
+            roll_ok &= bool(np.all(np.abs(x) <= 1 + 1e-12))
+        assert can_ok == roll_ok
+
+
+def test_canonical_problems_wellformed():
+    for name in names():
+        prob = make(name)
+        can = prob.canonical
+        assert can.H.shape[0] == can.n_delta >= 1
+        assert can.G.shape == (can.n_delta, can.nc, can.nz)
+        assert can.u_map.shape == (can.n_delta, prob.n_u, can.nz)
+        for d in range(can.n_delta):
+            eig = np.linalg.eigvalsh(can.H[d])
+            assert eig.min() > 0, f"{name}: H[{d}] not PD"
+
+
+def test_zoh_double_integrator():
+    Ac = np.array([[0.0, 1.0], [0.0, 0.0]])
+    Bc = np.array([[0.0], [1.0]])
+    A, B = base.zoh(Ac, Bc, 0.5)
+    np.testing.assert_allclose(A, [[1.0, 0.5], [0.0, 1.0]], atol=1e-12)
+    np.testing.assert_allclose(B, [[0.125], [0.5]], atol=1e-12)

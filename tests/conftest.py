@@ -2,19 +2,40 @@
 
 Mesh/sharding logic is tested without a TPU via XLA's host-platform device
 splitting (SURVEY.md section 5: "multi-device tests via jax CPU-device
-simulation").  Must run before jax is imported anywhere.
+simulation").
+
+Platform forcing note: this container's axon TPU plugin registers itself at
+interpreter start (sitecustomize) and calls
+``jax.config.update("jax_platforms", "axon,cpu")``, which OVERRIDES the
+``JAX_PLATFORMS`` environment variable.  Setting the env var alone silently
+runs "CPU" tests on the tunnelled TPU chip; the only reliable override is a
+second ``jax.config.update`` after importing jax, before any backend
+initialization.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Must be in the environment before the CPU client is created.
 flags = os.environ.get("XLA_FLAGS", "")
 if "host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
 
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _assert_cpu_platform():
+    """Guard against the axon plugin silently re-grabbing the tests."""
+    assert jax.default_backend() == "cpu", (
+        f"tests must run on CPU, got {jax.default_backend()}")
+    assert len(jax.devices()) == 8, (
+        f"expected 8 virtual CPU devices, got {len(jax.devices())}")
 
 
 @pytest.fixture(scope="session")

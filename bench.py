@@ -1,0 +1,126 @@
+"""Headline benchmark: offline partition-build throughput (regions/sec).
+
+Protocol (BASELINE.md): build the eps-suboptimal partition of the flagship
+benchmark on the default device backend (TPU when present), measure
+regions/sec, and compare against the *serial oracle* baseline -- the
+stand-in for the reference's one-Gurobi-solve-at-a-time hot loop
+(BASELINE.json north_star: ">=100x offline partition-build speedup vs. the
+serial ... oracle").  The serial wall time is estimated as
+(measured per-solve serial latency) x (solves the batched run issued);
+running the full serial build would take hours by construction.
+
+Prints exactly ONE JSON line on stdout:
+  {"metric": ..., "value": regions/sec, "unit": "regions/s",
+   "vs_baseline": speedup_over_serial, ...extras}
+All progress goes to stderr.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def main() -> None:
+    import jax
+
+    from explicit_hybrid_mpc_tpu.config import PartitionConfig
+    from explicit_hybrid_mpc_tpu.oracle.oracle import Oracle
+    from explicit_hybrid_mpc_tpu.partition.frontier import build_partition
+    from explicit_hybrid_mpc_tpu.problems.registry import make, names
+
+    platform = jax.default_backend()
+    log(f"platform: {platform}, devices: {jax.devices()}")
+
+    problem_name = ("inverted_pendulum" if "inverted_pendulum" in names()
+                    else "double_integrator")
+    problem = make(problem_name)
+    eps_a = 1e-2
+
+    # -- batched build on the default backend ------------------------------
+    cfg = PartitionConfig(problem=problem_name, eps_a=eps_a,
+                          backend="device", batch_simplices=512,
+                          max_steps=5000)
+    oracle = Oracle(problem, backend="device")
+    # Warm the jit caches so compile time is excluded: compile every
+    # power-of-two vertex-batch bucket up front, then a tiny build for the
+    # simplex-query programs.
+    rng = np.random.default_rng(42)
+    b = 8
+    while b <= oracle.max_points_per_call:
+        log(f"warmup: bucket {b}")
+        oracle.solve_vertices(rng.uniform(problem.theta_lb, problem.theta_ub,
+                                          size=(b, problem.n_theta)))
+        b *= 2
+    log("warmup build (simplex-query programs)...")
+    warm_cfg = PartitionConfig(problem=problem_name, eps_a=1.0,
+                               backend="device", batch_simplices=512,
+                               max_steps=50)
+    build_partition(problem, warm_cfg, oracle=oracle)
+    oracle.n_solves = oracle.n_point_solves = oracle.n_simplex_solves = 0
+
+    log("timed build...")
+    res = build_partition(problem, cfg, oracle=oracle)
+    stats = res.stats
+    n_point = oracle.n_point_solves
+    n_simplex = oracle.n_simplex_solves
+    log(f"build stats: {stats}")
+    regions_per_s = stats["regions_per_s"]
+
+    # -- serial-oracle baseline estimate -----------------------------------
+    # Point QPs and joint simplex QPs are structurally different sizes:
+    # time each kind separately and weight by the counts the batched run
+    # actually issued.
+    from explicit_hybrid_mpc_tpu.partition import geometry
+
+    serial = Oracle(problem, backend="serial")
+    rng2 = np.random.default_rng(0)
+    pts = rng2.uniform(problem.theta_lb, problem.theta_ub,
+                       size=(8, problem.n_theta))
+    serial.solve_vertices(pts[:2])  # compile
+    t0 = time.perf_counter()
+    serial.solve_vertices(pts)
+    per_point = (time.perf_counter() - t0) / len(pts)
+    nd = problem.canonical.n_delta
+    per_solve = per_point / nd
+
+    per_simplex = 0.0
+    if n_simplex:
+        span = problem.theta_ub - problem.theta_lb
+        V0 = np.vstack([problem.theta_lb,
+                        problem.theta_lb + 0.1 * np.diag(span)])
+        M = geometry.barycentric_matrix(V0)[None]
+        serial.solve_simplex_min(M, np.zeros(1, dtype=np.int64))  # compile
+        t0 = time.perf_counter()
+        for _ in range(4):  # serial: one joint QP pair at a time
+            serial.solve_simplex_min(M, np.zeros(1, dtype=np.int64))
+        per_simplex = (time.perf_counter() - t0) / 8  # 2 solves per call
+
+    serial_wall = per_solve * n_point + per_simplex * n_simplex
+    speedup = serial_wall / stats["wall_s"]
+    log(f"serial: {per_solve*1e3:.2f} ms/point-solve x {n_point}, "
+        f"{per_simplex*1e3:.2f} ms/simplex-solve x {n_simplex} -> est. "
+        f"serial wall {serial_wall:.1f}s vs batched {stats['wall_s']:.1f}s")
+
+    print(json.dumps({
+        "metric": f"offline regions/sec ({problem_name}, eps_a={eps_a}, "
+                  f"{platform})",
+        "value": round(regions_per_s, 2),
+        "unit": "regions/s",
+        "vs_baseline": round(speedup, 2),
+        "regions": stats["regions"],
+        "oracle_solves": stats["oracle_solves"],
+        "wall_s": round(stats["wall_s"], 2),
+        "serial_ms_per_solve": round(per_solve * 1e3, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

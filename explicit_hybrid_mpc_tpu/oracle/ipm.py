@@ -74,7 +74,13 @@ def qp_solve(Q: jax.Array, q: jax.Array, A: jax.Array, b: jax.Array,
     resid0 = A @ z0 - b
     shift = jnp.maximum(1.0, 1.1 * jnp.max(jnp.maximum(resid0, 0.0)))
     s0 = jnp.maximum(b - A @ z0, 0.0) + shift
-    lam0 = jnp.ones(nc, dtype=dtype)
+    # `vary` carries the union of the inputs' varying-manual-axes type so
+    # the fori_loop carry is vma-stable under shard_map (all inputs are
+    # finite by canonicalization, so the product is exactly zero).
+    vary = 0.0 * (jnp.sum(Q) + jnp.sum(q) + jnp.sum(A) + jnp.sum(b))
+    z0 = z0 + vary
+    s0 = s0 + vary
+    lam0 = jnp.ones(nc, dtype=dtype) + vary
 
     scale_p = 1.0 + jnp.max(jnp.abs(b))
     scale_d = 1.0 + jnp.max(jnp.abs(q))

@@ -92,20 +92,40 @@ def _solve_one(prob: DeviceProblem, theta: jax.Array, d: int, n_iter: int):
     return V, sol.converged, grad, u0, sol.z
 
 
-def _solve_points_all_deltas(prob: DeviceProblem, thetas: jax.Array,
-                             n_iter: int):
-    """(P points) x (nd commutations) in one vmapped program."""
+def _solve_points_grid(prob: DeviceProblem, thetas: jax.Array, n_iter: int):
+    """(P points) x (nd commutations) raw grid solve, no reduction.
+
+    The delta reduction is split out so parallel/mesh.py can shard the grid
+    over a 2-D (batch, delta) device mesh and do the argmin with an
+    all-gather collective over the delta axis.
+    """
     nd = prob.H.shape[0]
 
     def per_point(theta):
-        V, conv, grad, u0, z = jax.vmap(
+        return jax.vmap(
             lambda d: _solve_one(prob, theta, d, n_iter))(jnp.arange(nd))
-        Vval = jnp.where(conv, V, jnp.inf)
-        dstar = jnp.argmin(Vval)  # first minimum: deterministic tie-break
-        Vstar = Vval[dstar]
-        return V, conv, grad, u0, z, Vstar, dstar
 
     return jax.vmap(per_point)(thetas)
+
+
+def reduce_deltas(V: jax.Array, conv: jax.Array):
+    """V*(theta), delta*(theta) from the (P, nd) grid values.
+
+    First minimum = deterministic tie-break (required for backend parity of
+    the produced tree, SURVEY.md section 8 "hard parts" item 3).
+    """
+    Vval = jnp.where(conv, V, jnp.inf)
+    dstar = jnp.argmin(Vval, axis=-1)
+    Vstar = jnp.take_along_axis(Vval, dstar[..., None], axis=-1)[..., 0]
+    return Vstar, dstar
+
+
+def _solve_points_all_deltas(prob: DeviceProblem, thetas: jax.Array,
+                             n_iter: int):
+    """(P points) x (nd commutations) in one vmapped program."""
+    V, conv, grad, u0, z = _solve_points_grid(prob, thetas, n_iter)
+    Vstar, dstar = reduce_deltas(V, conv)
+    return V, conv, grad, u0, z, Vstar, dstar
 
 
 def _simplex_feas_one(prob: DeviceProblem, bary_M: jax.Array, d: int,
@@ -183,12 +203,25 @@ def _solve_simplex_min_one(prob: DeviceProblem, bary_M: jax.Array,
 class Oracle:
     """Solver plugin boundary with selectable backend."""
 
-    def __init__(self, problem, backend: str = "cpu", n_iter: int = 30):
+    def __init__(self, problem, backend: str = "cpu", n_iter: int = 30,
+                 mesh=None):
+        """mesh: optional jax.sharding.Mesh with ("batch", "delta") axes;
+        when given, solve_vertices shards the (points x commutations) grid
+        over it (parallel/mesh.py) instead of running on a single device --
+        the TPU-native counterpart of adding MPI worker ranks."""
         self.problem = problem
         self.can = problem.canonical
         self.backend = backend
         self.n_iter = n_iter
-        self.n_solves = 0  # statistics: individual QP solves issued
+        self.mesh = mesh
+        # Statistics: individual QP solves issued, split by kind -- the
+        # point QPs (fixed-commutation solves at a parameter point) and
+        # the joint simplex-wide QPs (min/phase-1 over (z, theta)), which
+        # are structurally larger; benchmark baselines must not conflate
+        # their per-solve costs.
+        self.n_solves = 0
+        self.n_point_solves = 0
+        self.n_simplex_solves = 0
         if backend in ("tpu", "gpu", "device"):
             platform = None  # default platform (the accelerator if present)
         elif backend in ("cpu", "serial"):
@@ -198,6 +231,11 @@ class Oracle:
         devs = jax.devices(platform) if platform else jax.devices()
         self.device = devs[0]
         self.prob = jax.device_put(to_device(self.can), self.device)
+        self._mesh_solver = None
+        if mesh is not None:
+            from explicit_hybrid_mpc_tpu.parallel.mesh import MeshSolver
+            self._mesh_solver = MeshSolver(to_device(self.can), mesh,
+                                           n_iter=n_iter)
 
         self._solve_points = jax.jit(
             functools.partial(_solve_points_all_deltas, n_iter=self.n_iter),
@@ -219,24 +257,46 @@ class Oracle:
 
     # -- the MICP-at-a-point query (reference: P_theta) --------------------
 
+    @property
+    def max_points_per_call(self) -> int:
+        """Point-batch cap per device program: bounds the (points x
+        commutations) grid to ~2^16 simultaneous QP solves (memory: the
+        kernel materializes one (nz, nz) Cholesky per grid cell) and caps
+        the number of distinct padded shapes XLA ever compiles."""
+        nd = max(1, self.can.n_delta)
+        cap = 1 << max(3, (65536 // nd).bit_length() - 1)
+        return min(2048, cap)
+
     def solve_vertices(self, thetas: np.ndarray) -> VertexSolution:
         """Solve the full enumeration at each point; pads the point batch
-        to power-of-two buckets so jit caches stay warm."""
+        to power-of-two buckets (bounded by max_points_per_call, larger
+        batches are chunked) so jit caches stay warm and small."""
         thetas = np.atleast_2d(np.asarray(thetas, dtype=np.float64))
         P = thetas.shape[0]
         nd = self.can.n_delta
         self.n_solves += P * nd
+        self.n_point_solves += P * nd
         if self.backend == "serial":
             outs = [self._solve_one_point(self.prob, jnp.asarray(t))
                     for t in thetas]
             parts = [np.concatenate([np.asarray(o[k]) for o in outs])
                      for k in range(7)]
             return VertexSolution(*self._finalize(parts))
-        Ppad = max(8, 1 << (P - 1).bit_length())
-        pad = np.zeros((Ppad - P, thetas.shape[1]))
-        out = self._solve_points(self.prob, jnp.asarray(
-            np.concatenate([thetas, pad])))
-        parts = [np.asarray(o)[:P] for o in out]
+        cap = self.max_points_per_call
+        chunks = []
+        for lo in range(0, P, cap):
+            chunk = thetas[lo:lo + cap]
+            Pc = chunk.shape[0]
+            if self._mesh_solver is not None:
+                out = self._mesh_solver(chunk)
+                chunks.append([np.asarray(o) for o in out])
+                continue
+            Ppad = min(cap, max(8, 1 << (Pc - 1).bit_length()))
+            pad = np.zeros((Ppad - Pc, thetas.shape[1]))
+            out = self._solve_points(self.prob, jnp.asarray(
+                np.concatenate([chunk, pad])))
+            chunks.append([np.asarray(o)[:Pc] for o in out])
+        parts = [np.concatenate([c[k] for c in chunks]) for k in range(7)]
         return VertexSolution(*self._finalize(parts))
 
     @staticmethod
@@ -265,6 +325,7 @@ class Oracle:
         if K == 0:
             return np.zeros(0), np.zeros(0, dtype=bool)
         self.n_solves += 2 * K
+        self.n_simplex_solves += 2 * K
         Kpad = max(8, 1 << (K - 1).bit_length())
         Mpad = np.concatenate(
             [bary_Ms, np.tile(np.eye(bary_Ms.shape[1])[None],
@@ -297,6 +358,7 @@ class Oracle:
             z = np.zeros(0)
             return z, z.astype(bool), z.astype(bool)
         self.n_solves += K
+        self.n_simplex_solves += K
         Kpad = max(8, 1 << (K - 1).bit_length())
         Mpad = np.concatenate(
             [bary_Ms, np.tile(np.eye(bary_Ms.shape[1])[None],

@@ -1,0 +1,4 @@
+from explicit_hybrid_mpc_tpu.parallel.mesh import (  # noqa: F401
+    MeshSolver, make_mesh, sharded_grid_solver)
+from explicit_hybrid_mpc_tpu.parallel.distributed import (  # noqa: F401
+    global_mesh, init_distributed, is_frontier_owner)

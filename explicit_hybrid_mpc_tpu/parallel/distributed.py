@@ -1,0 +1,56 @@
+"""Multi-host scale-out (the reference's `mpirun -n K` counterpart).
+
+The reference distributes the partition build with an MPI task farm:
+scheduler rank 0 plus worker ranks exchanging pickled branches (SURVEY.md
+sections 3-4 [M-high]).  The TPU-native design needs no application-level
+messaging at all: after `jax.distributed.initialize`, every process runs
+the SAME SPMD frontier program over one global mesh; XLA's collectives
+(ICI within a slice, DCN across hosts) move the data.  The host-side
+frontier -- the only mutable state -- lives on process 0, mirroring the
+reference's single-scheduler design (SURVEY.md section 6.2/6.8).
+
+Single-process runs skip initialization entirely, so the same code path
+serves one chip, one host with N chips, and multi-host pods.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+
+
+def init_distributed(coordinator_address: Optional[str] = None,
+                     num_processes: Optional[int] = None,
+                     process_id: Optional[int] = None) -> int:
+    """Initialize jax.distributed when running multi-process; no-op
+    otherwise.  Returns this process's id (0 for single-process).
+
+    All arguments default to JAX's environment auto-detection
+    (JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES / JAX_PROCESS_ID), the
+    moral equivalent of MPI's launcher-provided rank/size.
+    """
+    if num_processes is None and coordinator_address is None:
+        return 0  # single process
+    jax.distributed.initialize(coordinator_address=coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id)
+    return jax.process_index()
+
+
+def is_frontier_owner() -> bool:
+    """True on the process that owns the host-side frontier + tree
+    (process 0 -- the reference's scheduler rank)."""
+    return jax.process_index() == 0
+
+
+def global_mesh(shape: Optional[Sequence[int]] = None):
+    """(batch, delta) mesh over ALL processes' devices.
+
+    Per-process addressable shards are handled by jax.make_array_from_
+    process_local_data when staging the frontier batch; with the default
+    batch-major layout each process solves a contiguous block of points.
+    """
+    from explicit_hybrid_mpc_tpu.parallel.mesh import make_mesh
+
+    return make_mesh(shape=shape, devices=jax.devices())

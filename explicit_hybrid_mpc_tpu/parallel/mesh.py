@@ -1,0 +1,154 @@
+"""Device-mesh parallelism for the batched oracle.
+
+This module is the TPU-native replacement for the reference's ONLY
+parallelism strategy, the MPI task farm over subdivision branches
+(SURVEY.md section 3 "Distributed runtime" [M-high]; section 6.8).  Where
+the reference passes pickled branches between a scheduler rank and worker
+ranks, here the frontier's solve batch is an array sharded over a
+`jax.sharding.Mesh` and XLA moves the data:
+
+- mesh axis ``batch``  -- shards the parameter points (the frontier's
+  unsolved simplex vertices).  Embarrassingly parallel; no communication
+  until the host gathers results.
+- mesh axis ``delta``  -- shards the commutation enumeration.  The
+  cross-commutation reduction V*(theta) = min_delta V_delta(theta) then
+  needs one ``all_gather`` over this axis (ICI-resident collective), after
+  which every device computes the same deterministic argmin.
+
+Multi-host scale-out uses the same SPMD program over a global mesh after
+``jax.distributed.initialize`` (see parallel/distributed.py); the frontier
+itself stays on process 0, mirroring the reference's single-scheduler
+design (SURVEY.md section 6.2: "single host frontier owner" -- no races by
+construction).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from explicit_hybrid_mpc_tpu.oracle.oracle import (
+    DeviceProblem, _solve_points_grid, reduce_deltas)
+
+
+def make_mesh(shape: Optional[Sequence[int]] = None,
+              devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """Build a (batch, delta) mesh from the available devices.
+
+    ``shape=None`` uses all local devices on the batch axis (delta axis 1):
+    the right default when nd is small or not a multiple of the device
+    count.  Pass e.g. ``shape=(4, 2)`` to also shard the commutation
+    enumeration (worthwhile for the quadrotor's 256-way delta grid).
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if shape is None:
+        shape = (len(devices), 1)
+    n = math.prod(shape)
+    if n > len(devices):
+        raise ValueError(f"mesh shape {tuple(shape)} needs {n} devices, "
+                         f"have {len(devices)}")
+    arr = np.asarray(devices[:n], dtype=object).reshape(tuple(shape))
+    return Mesh(arr, ("batch", "delta"))
+
+
+def _replicate_pad_deltas(prob: DeviceProblem, n_delta_shards: int
+                          ) -> tuple[DeviceProblem, int]:
+    """Pad the commutation axis to a multiple of the delta mesh axis.
+
+    Padding replicates slice 0; padded slices are masked out of the
+    reduction by the caller (their conv flag is ignored via delta_mask).
+    """
+    nd = prob.H.shape[0]
+    nd_pad = -(-nd // n_delta_shards) * n_delta_shards
+    if nd_pad == nd:
+        return prob, nd
+    reps = [jnp.concatenate([a, jnp.repeat(a[:1], nd_pad - nd, axis=0)])
+            for a in prob]
+    return DeviceProblem(*reps), nd
+
+
+def sharded_grid_solver(mesh: Mesh, n_iter: int):
+    """Build the sharded (points x deltas) solver for `mesh`.
+
+    Returns ``fn(prob, thetas, delta_mask) -> (V, conv, grad, u0, z,
+    Vstar, dstar)`` where:
+
+    - ``prob`` has its commutation axis padded to a multiple of the delta
+      mesh axis (see `_replicate_pad_deltas`) and is sharded along it;
+    - ``thetas`` (P, n_theta) has P a multiple of the batch mesh axis and
+      is sharded along it;
+    - ``delta_mask`` (nd_pad,) bool marks real (non-padding) commutations.
+
+    The per-delta outputs come back sharded (batch, delta).  The
+    cross-commutation argmin runs OUTSIDE the shard_map (still inside the
+    caller's jit): XLA partitions the reduction itself and inserts the
+    collective over the delta axis -- the vma type system cannot express
+    "replicated after gather" inside shard_map, and hand-writing the
+    gather there buys nothing over letting the partitioner do it.
+    """
+
+    def local(prob, thetas, delta_mask):
+        V, conv, grad, u0, z = _solve_points_grid(prob, thetas, n_iter)
+        conv = conv & delta_mask[None, :]
+        return V, conv, grad, u0, z
+
+    spec_pd = P("batch", "delta")
+    return shard_map(
+        local, mesh=mesh,
+        in_specs=(P("delta"), P("batch"), P("delta")),
+        out_specs=(spec_pd, spec_pd, spec_pd, spec_pd, spec_pd))
+
+
+class MeshSolver:
+    """Host-facing wrapper: pads/stages inputs, unpads outputs.
+
+    Drop-in for the dense path in Oracle.solve_vertices: same 7-tuple
+    contract, but the work is sharded over `mesh`.
+    """
+
+    def __init__(self, prob: DeviceProblem, mesh: Mesh, n_iter: int = 30):
+        from jax.sharding import NamedSharding
+
+        self.mesh = mesh
+        self.n_batch = mesh.shape["batch"]
+        n_delta_shards = mesh.shape["delta"]
+        prob, self.nd = _replicate_pad_deltas(prob, n_delta_shards)
+        # Stage the (constant) problem arrays in their delta-sharded layout
+        # once, so each solve call doesn't re-distribute them from the
+        # default device.
+        self.prob = jax.device_put(prob, NamedSharding(mesh, P("delta")))
+        nd_pad = self.prob.H.shape[0]
+        self.delta_mask = jax.device_put(jnp.arange(nd_pad) < self.nd,
+                                         NamedSharding(mesh, P("delta")))
+        grid = sharded_grid_solver(mesh, n_iter)
+
+        def staged(prob, thetas, delta_mask):
+            V, conv, grad, u0, z = grid(prob, thetas, delta_mask)
+            Vstar, dstar = reduce_deltas(V, conv)
+            return V, conv, grad, u0, z, Vstar, dstar
+
+        self._fn = jax.jit(staged)
+
+    def pad_batch(self, P_: int) -> int:
+        """Static batch size: next power of two >= P_, rounded up to a
+        multiple of the batch mesh axis (shard_map needs even divisibility;
+        powers of two alone fail on e.g. a 6-device batch axis)."""
+        pow2 = max(1, 1 << max(0, (P_ - 1).bit_length()))
+        return -(-max(pow2, self.n_batch) // self.n_batch) * self.n_batch
+
+    def __call__(self, thetas: np.ndarray):
+        Pn = thetas.shape[0]
+        Ppad = self.pad_batch(Pn)
+        pad = np.zeros((Ppad - Pn, thetas.shape[1]))
+        out = self._fn(self.prob, jnp.asarray(np.concatenate([thetas, pad])),
+                       self.delta_mask)
+        # Unpad points and (for per-delta outputs) padded commutations.
+        V, conv, grad, u0, z, Vstar, dstar = out
+        return (V[:Pn, :self.nd], conv[:Pn, :self.nd], grad[:Pn, :self.nd],
+                u0[:Pn, :self.nd], z[:Pn, :self.nd], Vstar[:Pn], dstar[:Pn])

@@ -76,8 +76,9 @@ class FrontierEngine:
         p = problem.n_theta
         self.tree = Tree(p=p, n_u=problem.n_u)
         self.roots = [self.tree.add_root(V) for V in
-                      geometry.kuhn_triangulation(problem.theta_lb,
-                                                  problem.theta_ub)]
+                      geometry.box_triangulation(
+                          problem.theta_lb, problem.theta_ub,
+                          getattr(problem, "root_splits", None))]
         self.frontier: collections.deque[int] = collections.deque(self.roots)
         self.cache = VertexCache()
         self.steps = 0

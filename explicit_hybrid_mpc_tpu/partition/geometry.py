@@ -58,6 +58,39 @@ def kuhn_triangulation(lb: np.ndarray, ub: np.ndarray) -> np.ndarray:
     return np.stack(sims)
 
 
+def box_triangulation(lb: np.ndarray, ub: np.ndarray,
+                      splits: dict | None = None) -> np.ndarray:
+    """Kuhn-triangulate the box after pre-splitting along axis planes.
+
+    ``splits`` maps axis index -> iterable of coordinate values; the box is
+    cut into sub-boxes at each value strictly inside the range, and every
+    sub-box is Kuhn-triangulated.  Returns (n_simplices, p+1, p).
+
+    Why pre-split: a problem whose commutation feasibility changes across a
+    fixed hyperplane in theta (e.g. PWA mode membership of the initial
+    state) can never certify a simplex STRADDLING that plane -- no single
+    commutation is feasible at vertices on both sides -- and longest-edge
+    bisection midpoints approach but need not ever hit the plane, so the
+    subdivision would refine forever.  Aligning root cell faces with the
+    plane makes every descendant stay in one closed halfspace.
+    """
+    lb = np.asarray(lb, dtype=np.float64)
+    ub = np.asarray(ub, dtype=np.float64)
+    boxes = [(lb, ub)]
+    for axis, values in sorted((splits or {}).items()):
+        new = []
+        for lo, hi in boxes:
+            cuts = [v for v in sorted(set(values))
+                    if lo[axis] < v < hi[axis]]
+            edges = [lo[axis]] + cuts + [hi[axis]]
+            for a, b in zip(edges[:-1], edges[1:]):
+                nlo, nhi = lo.copy(), hi.copy()
+                nlo[axis], nhi[axis] = a, b
+                new.append((nlo, nhi))
+        boxes = new
+    return np.concatenate([kuhn_triangulation(lo, hi) for lo, hi in boxes])
+
+
 def barycentric_matrix(V: np.ndarray) -> np.ndarray:
     """Matrix M with lambda = M @ [theta; 1] the barycentric coordinates.
 

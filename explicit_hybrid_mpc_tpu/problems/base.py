@@ -287,6 +287,14 @@ class HybridMPC:
     theta_lb: np.ndarray
     theta_ub: np.ndarray
     n_u: int
+    # Axis-aligned hyperplanes (axis -> coordinate values) that the ROOT
+    # triangulation must align with: any fixed theta-hyperplane across
+    # which commutation feasibility flips (e.g. PWA mode membership of
+    # x_0) must land on root cell faces or cells straddling it can never
+    # certify (see geometry.box_triangulation).  None = no splits;
+    # subclasses ASSIGN a fresh dict (a mutable class-level default would
+    # be shared across every problem).
+    root_splits = None
 
     @property
     def n_theta(self) -> int:

@@ -1,0 +1,96 @@
+"""Inverted-pendulum hybrid benchmark: canonicalization, oracle
+enumeration soundness, PWA continuity at the wall, and a partition build.
+"""
+
+import numpy as np
+import pytest
+from scipy.optimize import minimize
+
+from explicit_hybrid_mpc_tpu.config import PartitionConfig
+from explicit_hybrid_mpc_tpu.oracle.oracle import Oracle
+from explicit_hybrid_mpc_tpu.partition.frontier import build_partition
+from explicit_hybrid_mpc_tpu.problems.registry import make
+
+
+@pytest.fixture(scope="module")
+def pend():
+    return make("inverted_pendulum", N=3)  # 8 commutations: fast tests
+
+
+@pytest.fixture(scope="module")
+def oracle(pend):
+    return Oracle(pend, backend="cpu")
+
+
+def _scipy_fixed_delta(can, d, theta):
+    """Ground-truth fixed-commutation solve via scipy SLSQP."""
+    H, f, F = can.H[d], can.f[d], can.F[d]
+    G, w, S = can.G[d], can.w[d], can.S[d]
+    q = f + F @ theta
+    b = w + S @ theta
+    res = minimize(
+        lambda z: 0.5 * z @ H @ z + q @ z, np.zeros(can.nz),
+        jac=lambda z: H @ z + q, method="SLSQP",
+        constraints=[{"type": "ineq", "fun": lambda z: b - G @ z,
+                      "jac": lambda z: -G}],
+        options={"maxiter": 300, "ftol": 1e-12})
+    if not res.success:
+        return None
+    theta_cost = (0.5 * theta @ can.Y[d] @ theta + can.pvec[d] @ theta
+                  + can.cconst[d])
+    return res.fun + theta_cost
+
+
+def test_canonical_shapes(pend):
+    can = pend.canonical
+    assert can.n_delta == 8
+    assert can.nz == 3
+    assert can.deltas.shape == (8, 3)
+    # Commutation 0 = all-free; its mode rows force th_k <= 0.
+    assert np.all(np.linalg.eigvalsh(can.H.reshape(-1, 3, 3)) > 0)
+
+
+def test_mode_membership_excludes_wrong_side(oracle):
+    """Deep in the free region, every delta starting with mode 1 must be
+    infeasible (its theta_con row demands th >= 0)."""
+    sol = oracle.solve_vertices(np.array([[-0.3, 0.0]]))
+    deltas = oracle.can.deltas
+    first_mode = deltas[:, 0]
+    assert not np.any(sol.conv[0, first_mode == 1] &
+                      np.isfinite(sol.V[0, first_mode == 1]))
+    assert np.isfinite(sol.Vstar[0])
+    assert first_mode[sol.dstar[0]] == 0
+
+
+def test_enumeration_matches_scipy(oracle, pend, rng):
+    """V* = min over scipy-solved fixed-delta QPs at sample points."""
+    can = pend.canonical
+    thetas = rng.uniform(pend.theta_lb, pend.theta_ub, size=(4, 2))
+    sol = oracle.solve_vertices(thetas)
+    for k, th in enumerate(thetas):
+        vals = [_scipy_fixed_delta(can, d, th) for d in range(can.n_delta)]
+        vals = [v for v in vals if v is not None]
+        assert vals, "scipy found no feasible commutation"
+        ref = min(vals)
+        assert np.isfinite(sol.Vstar[k])
+        np.testing.assert_allclose(sol.Vstar[k], ref, rtol=1e-5, atol=1e-7)
+
+
+def test_value_continuity_at_wall(oracle):
+    """The PWA field is continuous at th = 0, so V* must be too."""
+    eps = 1e-6
+    for w in (-0.5, 0.0, 0.5):
+        pair = np.array([[-eps, w], [eps, w]])
+        sol = oracle.solve_vertices(pair)
+        assert np.all(np.isfinite(sol.Vstar))
+        np.testing.assert_allclose(sol.Vstar[0], sol.Vstar[1],
+                                   rtol=1e-4, atol=1e-6)
+
+
+def test_partition_build_certifies(pend):
+    cfg = PartitionConfig(problem="inverted_pendulum", eps_a=0.5,
+                          backend="cpu", batch_simplices=64, max_steps=400)
+    res = build_partition(pend, cfg)
+    assert res.stats["regions"] > 0
+    assert not res.stats["truncated"]
+    assert res.stats["uncertified"] == 0

@@ -93,15 +93,22 @@ def main() -> None:
 
     per_simplex = 0.0
     if n_simplex:
+        # solve_simplex_min pads K to >=8 rows, so time a FULL 8-row batch
+        # and divide by the 16 counted solves (8 min-QPs + 8 phase-1s) it
+        # actually runs; a K=1 call would execute the same 16 padded QPs
+        # and overstate the per-solve cost ~8x.  vmap amortization makes
+        # this a LOWER bound on true one-at-a-time serial cost, i.e. the
+        # reported speedup is conservative.
         span = problem.theta_ub - problem.theta_lb
         V0 = np.vstack([problem.theta_lb,
                         problem.theta_lb + 0.1 * np.diag(span)])
-        M = geometry.barycentric_matrix(V0)[None]
-        serial.solve_simplex_min(M, np.zeros(1, dtype=np.int64))  # compile
+        M8 = np.tile(geometry.barycentric_matrix(V0)[None], (8, 1, 1))
+        d8 = np.zeros(8, dtype=np.int64)
+        serial.solve_simplex_min(M8, d8)  # compile
         t0 = time.perf_counter()
-        for _ in range(4):  # serial: one joint QP pair at a time
-            serial.solve_simplex_min(M, np.zeros(1, dtype=np.int64))
-        per_simplex = (time.perf_counter() - t0) / 8  # 2 solves per call
+        for _ in range(4):
+            serial.solve_simplex_min(M8, d8)
+        per_simplex = (time.perf_counter() - t0) / (4 * 16)
 
     serial_wall = per_solve * n_point + per_simplex * n_simplex
     speedup = serial_wall / stats["wall_s"]

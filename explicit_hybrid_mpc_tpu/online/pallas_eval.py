@@ -1,0 +1,203 @@
+"""Hand-tiled Pallas TPU kernel for online PWA point location.
+
+The online controller is `locate the leaf simplex containing theta, then
+barycentrically interpolate the vertex inputs` (SURVEY.md section 4.2 [P];
+BASELINE.json north-star: "a Pallas point-in-simplex + affine-eval kernel").
+The pure-JAX reference (online/evaluator.py) materializes the full
+(queries x leaves) barycentric tensor in HBM; for 10^5-leaf partitions that
+tensor, not the arithmetic, is the cost.  This kernel streams leaf tiles
+through VMEM instead and keeps only a running (best score, best leaf) per
+query -- the flash-attention trick applied to point location:
+
+  grid = (query tiles, leaf tiles), leaf axis innermost;
+  per step: score[b, l] = min_i  th1[b] . bary[i, :, l]   (PV small matmuls
+            on the MXU, min on the VPU);
+            running argmax update in VMEM scratch;
+  at the last leaf tile: write (best score, best leaf index).
+
+HBM traffic is exactly one pass over the leaf table per 128-query tile, and
+nothing of size (B x L) is ever materialized.  The affine evaluation itself
+(a (p+1)-point interpolation on the located leaf) is a cheap gather done in
+plain JAX at f64 -- point location is where the work is.
+
+Point location runs in f32: TPU has no native f64, and containment scores
+only *select* a leaf (ties at shared faces are resolved either way to the
+same interpolated law on conforming meshes).  The interpolation then uses
+the f64 tables.  Tests cross-check against the f64 pure-JAX evaluator.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from explicit_hybrid_mpc_tpu.online.evaluator import EvalResult
+from explicit_hybrid_mpc_tpu.online.export import LeafTable
+
+# Leaf-tile width: lane dimension of the score tile.
+_TL = 128
+# Query-tile height.
+_TB = 128
+# Sentinel magnitudes for padded vertices (+BIG: never the min) and padded
+# leaves (-BIG: never the argmax).  Well inside f32 range so arithmetic
+# with real scores stays finite.
+_BIG = 1e30
+
+
+def _cdiv(a: int, b: int) -> int:
+    return -(-a // b)
+
+
+class PallasLeafTable(NamedTuple):
+    """Leaf table staged for the locate kernel.
+
+    bary_T: (PV, K, Lpad) f32 -- bary_T[i, :, l] is vertex i's barycentric
+            row of leaf l, zero-padded in K; padded vertices/leaves carry
+            +/-_BIG at the homogeneous column so min/argmax ignore them.
+    """
+
+    bary_T: jax.Array
+    n_leaves: int
+    p: int
+
+    @property
+    def n_pad_leaves(self) -> int:
+        return self.bary_T.shape[2]
+
+
+def stage_pallas(table: LeafTable) -> PallasLeafTable:
+    """Host-side pack: LeafTable -> padded f32 transposed layout."""
+    L, pp1, _ = table.bary_M.shape
+    p = pp1 - 1
+    PV = max(8, 1 << (pp1 - 1).bit_length())    # padded vertex count
+    K = 8 * _cdiv(pp1, 8)                        # padded contraction dim
+    Lpad = _TL * _cdiv(L, _TL)
+    bary = np.zeros((PV, K, Lpad), dtype=np.float32)
+    # Real data: bary[i, j, l] = bary_M[l, i, j].
+    bary[:pp1, :pp1, :L] = np.ascontiguousarray(
+        table.bary_M.transpose(1, 2, 0), dtype=np.float32)
+    # Padded vertices of real leaves: lam = +BIG (the homogeneous entry of
+    # th1 is 1, so a row [0..0, BIG, 0..] at column p yields BIG).
+    bary[pp1:, p, :L] = _BIG
+    # Padded leaves: every vertex lam = -BIG => score -BIG, never selected.
+    bary[:, p, L:] = -_BIG
+    return PallasLeafTable(bary_T=jnp.asarray(bary), n_leaves=L, p=p)
+
+
+def _locate_kernel(th_ref, bary_ref, val_ref, idx_ref, best_val, best_idx):
+    """One (query tile, leaf tile) step of the streaming argmax."""
+    lt = pl.program_id(1)
+
+    @pl.when(lt == 0)
+    def _():
+        best_val[:] = jnp.full_like(best_val, -jnp.inf)
+        best_idx[:] = jnp.zeros_like(best_idx)
+
+    th = th_ref[:]                                   # (TB, K)
+    PV = bary_ref.shape[0]
+    score = jnp.full((th.shape[0], _TL), _BIG, dtype=jnp.float32)
+    for i in range(PV):                              # PV is static & small
+        # HIGHEST: true-f32 MXU passes -- default f32 matmul goes through
+        # bf16 and costs ~3 decimal digits of containment margin.
+        lam_i = jnp.dot(th, bary_ref[i],
+                        preferred_element_type=jnp.float32,
+                        precision=jax.lax.Precision.HIGHEST)  # (TB, TL)
+        score = jnp.minimum(score, lam_i)
+
+    # First-match argmax within the tile (matches jnp.argmax tie-break).
+    iota = jax.lax.broadcasted_iota(jnp.int32, score.shape, 1) + lt * _TL
+    tile_max = jnp.max(score, axis=1, keepdims=True)          # (TB, 1)
+    tile_idx = jnp.min(jnp.where(score == tile_max, iota, jnp.int32(2**30)),
+                       axis=1, keepdims=True)
+    # Strict > keeps the earliest tile on cross-tile ties.  Running best is
+    # lane-replicated: explicit broadcast, stores don't broadcast.
+    shape = best_val.shape
+    better = jnp.broadcast_to(tile_max > best_val[:, 0:1], shape)
+    best_val[:] = jnp.where(better, jnp.broadcast_to(tile_max, shape),
+                            best_val[:])
+    best_idx[:] = jnp.where(better, jnp.broadcast_to(tile_idx, shape),
+                            best_idx[:])
+
+    @pl.when(lt == pl.num_programs(1) - 1)
+    def _():
+        val_ref[:] = best_val[:]
+        idx_ref[:] = best_idx[:]
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def locate(ptable: PallasLeafTable, thetas: jax.Array,
+           interpret: bool = False) -> tuple[jax.Array, jax.Array]:
+    """Best-containing leaf per query: (leaf_idx (B,) i32, score (B,) f32).
+
+    score >= -tol  <=>  theta is inside leaf_idx's simplex.
+    """
+    B, p = thetas.shape
+    PV, K, Lpad = ptable.bary_T.shape
+    Bpad = _TB * _cdiv(B, _TB)
+    th1 = jnp.zeros((Bpad, K), dtype=jnp.float32)
+    th1 = th1.at[:B, :p].set(thetas.astype(jnp.float32))
+    th1 = th1.at[:B, p].set(1.0)
+    # Padded queries stay all-zero: their scores are garbage, sliced off.
+
+    grid = (Bpad // _TB, Lpad // _TL)
+    # x64 is enabled globally (the IPM needs it) but Mosaic has no i64:
+    # trace the kernel with x64 off so index-map and iota constants lower
+    # as i32.  Everything here is f32/i32 by construction.
+    with jax.enable_x64(False):
+        val, idx = _locate_call(grid, PV, K, th1, ptable.bary_T, interpret)
+    return idx[:B, 0], val[:B, 0]
+
+
+def _locate_call(grid, PV, K, th1, bary_T, interpret):
+    Bpad = th1.shape[0]
+    return pl.pallas_call(
+        _locate_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((_TB, K), lambda b, lt: (b, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((PV, K, _TL), lambda b, lt: (0, 0, lt),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((_TB, 128), lambda b, lt: (b, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((_TB, 128), lambda b, lt: (b, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((Bpad, 128), jnp.float32),
+            jax.ShapeDtypeStruct((Bpad, 128), jnp.int32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((_TB, 128), jnp.float32),
+            pltpu.VMEM((_TB, 128), jnp.int32),
+        ],
+        interpret=interpret,
+    )(th1, bary_T)
+
+
+def evaluate(ptable: PallasLeafTable, dev_table, thetas: jax.Array,
+             tol: float = 1e-4, interpret: bool = False) -> EvalResult:
+    """Pallas-located, f64-interpolated PWA evaluation.
+
+    dev_table: online.evaluator.DeviceLeafTable (the f64 arrays) -- the
+    located leaf's barycentric matrix and vertex data are gathered from it
+    so the control law itself is computed at full precision.
+    """
+    leaf, score = locate(ptable, thetas, interpret=interpret)
+    B = thetas.shape[0]
+    th1 = jnp.concatenate(
+        [thetas, jnp.ones((B, 1), dev_table.bary_M.dtype)], axis=1)
+    M_best = dev_table.bary_M[leaf]                  # (B, p+1, p+1)
+    lam = jnp.einsum("bij,bj->bi", M_best, th1)
+    u = jnp.einsum("bi,bin->bn", lam, dev_table.U[leaf])
+    cost = jnp.einsum("bi,bi->b", lam, dev_table.V[leaf])
+    inside = score >= -tol
+    return EvalResult(u=u, cost=cost, leaf=leaf, inside=inside)

@@ -232,6 +232,9 @@ class Oracle:
         self.device = devs[0]
         self.prob = jax.device_put(to_device(self.can), self.device)
         self._mesh_solver = None
+        if mesh is not None and backend == "serial":
+            raise ValueError("backend='serial' is the one-solve-at-a-time "
+                             "baseline; it cannot shard over a mesh")
         if mesh is not None:
             from explicit_hybrid_mpc_tpu.parallel.mesh import MeshSolver
             self._mesh_solver = MeshSolver(to_device(self.can), mesh,
@@ -274,6 +277,13 @@ class Oracle:
         thetas = np.atleast_2d(np.asarray(thetas, dtype=np.float64))
         P = thetas.shape[0]
         nd = self.can.n_delta
+        nt, nu, nz = self.can.n_theta, self.can.n_u, self.can.nz
+        if P == 0:
+            return VertexSolution(
+                V=np.zeros((0, nd)), conv=np.zeros((0, nd), dtype=bool),
+                grad=np.zeros((0, nd, nt)), u0=np.zeros((0, nd, nu)),
+                z=np.zeros((0, nd, nz)), Vstar=np.zeros(0),
+                dstar=np.zeros(0, dtype=np.int64))
         self.n_solves += P * nd
         self.n_point_solves += P * nd
         if self.backend == "serial":

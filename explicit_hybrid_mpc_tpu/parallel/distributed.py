@@ -30,8 +30,13 @@ def init_distributed(coordinator_address: Optional[str] = None,
     (JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES / JAX_PROCESS_ID), the
     moral equivalent of MPI's launcher-provided rank/size.
     """
-    if num_processes is None and coordinator_address is None:
-        return 0  # single process
+    import os
+
+    env_configured = ("JAX_COORDINATOR_ADDRESS" in os.environ
+                      or "COORDINATOR_ADDRESS" in os.environ)
+    if (num_processes is None and coordinator_address is None
+            and not env_configured):
+        return 0  # single process, nothing to coordinate
     jax.distributed.initialize(coordinator_address=coordinator_address,
                                num_processes=num_processes,
                                process_id=process_id)

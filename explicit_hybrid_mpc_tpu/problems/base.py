@@ -219,6 +219,38 @@ def condense(
                           cconst=cconst, u_map=u_map)
 
 
+def soften(sl: CondensedSlice, rows: np.ndarray,
+           rho: float = 1e3) -> CondensedSlice:
+    """Soften the given constraint rows with quadratic-penalty slacks.
+
+    Each row i in `rows` becomes  G_i z - s_i <= w_i + S_i theta,  s_i >= 0,
+    with rho/2 * s_i^2 added to the cost.  Use on constraints whose hard
+    version would make the feasible parameter set's boundary cut through
+    Theta along a dynamics-dependent (curved) surface: simplices straddling
+    such a surface can never certify and subdivide to the depth cap,
+    whereas the softened V_delta is finite and continuous on ALL of Theta
+    and the eps-certificate closes at finite depth.
+    """
+    rows = np.asarray(rows, dtype=np.int64)
+    nz = sl.H.shape[0]
+    ns = len(rows)
+    nt = sl.F.shape[1]
+    nc = sl.G.shape[0]
+    H = np.block([[sl.H, np.zeros((nz, ns))],
+                  [np.zeros((ns, nz)), rho * np.eye(ns)]])
+    f = np.concatenate([sl.f, np.zeros(ns)])
+    F = np.vstack([sl.F, np.zeros((ns, nt))])
+    sel = np.zeros((nc, ns))
+    sel[rows, np.arange(ns)] = 1.0
+    G = np.block([[sl.G, -sel],
+                  [np.zeros((ns, nz)), -np.eye(ns)]])
+    w = np.concatenate([sl.w, np.zeros(ns)])
+    S = np.vstack([sl.S, np.zeros((ns, nt))])
+    u_map = np.hstack([sl.u_map, np.zeros((sl.u_map.shape[0], ns))])
+    return CondensedSlice(H=H, f=f, F=F, G=G, w=w, S=S, Y=sl.Y,
+                          pvec=sl.pvec, cconst=sl.cconst, u_map=u_map)
+
+
 def stack_slices(slices: Sequence[CondensedSlice],
                  deltas: np.ndarray) -> CanonicalMPQP:
     """Stack per-commutation slices, padding constraint rows to a common

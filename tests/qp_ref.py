@@ -1,0 +1,52 @@
+"""Independent dense-QP ground truth for tests: OSQP-style ADMM.
+
+Solves  min 0.5 z'Hz + q'z  s.t.  Gz <= b  with an implementation sharing
+no code with the framework's IPM (different algorithm family entirely), so
+agreement is meaningful evidence of correctness.  Small/medium problems
+only -- this is a test oracle, not a solver.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def admm_qp(H, q, G, b, rho: float = 10.0, sigma: float = 1e-6,
+            max_iter: int = 50_000, tol: float = 1e-9):
+    """Returns (z, obj, converged)."""
+    H, q = np.asarray(H, float), np.asarray(q, float)
+    G, b = np.asarray(G, float), np.asarray(b, float)
+    nz = H.shape[0]
+    # Row equilibration of G: ADMM is scaling-sensitive.
+    rn = np.maximum(np.linalg.norm(G, axis=1), 1e-12)
+    Gs, bs = G / rn[:, None], b / rn
+    K = H + sigma * np.eye(nz) + rho * Gs.T @ Gs
+    cho = np.linalg.cholesky(K)
+    z = np.zeros(nz)
+    y = np.minimum(Gs @ z, bs)
+    u = np.zeros_like(bs)
+    for it in range(max_iter):
+        rhs = -q + sigma * z + rho * Gs.T @ (y - u)
+        z_new = np.linalg.solve(cho.T, np.linalg.solve(cho, rhs))
+        Gz = Gs @ z_new
+        y_new = np.minimum(bs, Gz + u)
+        u += Gz - y_new
+        r_prim = np.max(np.abs(Gz - y_new))
+        r_dual = rho * np.max(np.abs(Gs.T @ (y_new - y)))
+        z, y = z_new, y_new
+        if r_prim < tol and r_dual < tol:
+            return z, 0.5 * z @ H @ z + q @ z, True
+    return z, 0.5 * z @ H @ z + q @ z, False
+
+
+def fixed_delta_value(can, d, theta, **kw):
+    """V_delta(theta) via ADMM, in the framework's canonical convention
+    (theta-cost terms included); None if ADMM fails to converge."""
+    q = can.f[d] + can.F[d] @ theta
+    b = can.w[d] + can.S[d] @ theta
+    z, obj, ok = admm_qp(can.H[d], q, can.G[d], b, **kw)
+    if not ok:
+        return None
+    th = np.asarray(theta, float)
+    return (obj + 0.5 * th @ can.Y[d] @ th + can.pvec[d] @ th
+            + can.cconst[d])

@@ -1,0 +1,152 @@
+"""CLI entry point for the offline partition build.
+
+Mirrors the reference's argparse surface -- example name, eps_a/eps_r,
+algorithm variant, parallelism degree (SURVEY.md section 2 L8 and
+section 3 "CLI / entry" [M-med]; exact flags UNVERIFIED, reference mount
+empty) -- with the MPI process count replaced by the TPU-native knobs
+(backend, mesh devices, device batch size).
+
+    python -m explicit_hybrid_mpc_tpu.main -e inverted_pendulum -a 1e-2 \
+        --backend tpu --batch 512 -o build/pend
+
+Outputs under --output PREFIX: PREFIX.tree.pkl (the simplex tree),
+PREFIX.stats.json (build statistics), PREFIX.log.jsonl (per-step metrics),
+and with --simulate, PREFIX.sim.json (closed-loop comparison).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="explicit_hybrid_mpc_tpu",
+        description="TPU-native approximate explicit hybrid MPC: "
+                    "offline partition build")
+    p.add_argument("-e", "--example", required=True,
+                   help="benchmark problem name (see --list)")
+    p.add_argument("-a", "--eps-a", type=float, default=None,
+                   help="absolute suboptimality tolerance eps_a "
+                        "(default 1e-2 when neither -a nor -r is given)")
+    p.add_argument("-r", "--eps-r", type=float, default=None,
+                   help="relative suboptimality tolerance eps_r")
+    p.add_argument("--algorithm", choices=("suboptimal", "feasible"),
+                   default="suboptimal",
+                   help="fully-explicit eps-suboptimal partition vs "
+                        "semi-explicit feasibility-only variant")
+    p.add_argument("--backend", choices=("tpu", "cpu", "serial"),
+                   default="tpu")
+    p.add_argument("--batch", type=int, default=256,
+                   help="frontier simplices per device step")
+    p.add_argument("--mesh", type=int, default=None, metavar="D",
+                   help="shard the solve batch over D local devices")
+    p.add_argument("--max-depth", type=int, default=40)
+    p.add_argument("--max-steps", type=int, default=10_000)
+    p.add_argument("--checkpoint-every", type=int, default=0, metavar="K",
+                   help="snapshot frontier+tree every K steps")
+    p.add_argument("--resume", metavar="CKPT",
+                   help="resume a build from a checkpoint file")
+    p.add_argument("-o", "--output", default="partition",
+                   help="output file prefix")
+    p.add_argument("--simulate", type=int, default=0, metavar="T",
+                   help="after the build, run a T-step closed-loop "
+                        "explicit-vs-implicit comparison")
+    p.add_argument("--problem-arg", action="append", default=[],
+                   metavar="K=V", help="problem constructor overrides, "
+                   "e.g. --problem-arg N=5 --problem-arg axes=1")
+    p.add_argument("--list", action="store_true",
+                   help="list registered problems and exit")
+    return p
+
+
+def _parse_problem_args(pairs: list[str]) -> dict:
+    out = {}
+    for kv in pairs:
+        if "=" not in kv:
+            raise SystemExit(f"--problem-arg needs K=V, got {kv!r}")
+        k, v = kv.split("=", 1)
+        try:
+            out[k] = json.loads(v)
+        except json.JSONDecodeError:
+            out[k] = v
+    return out
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+
+    from explicit_hybrid_mpc_tpu.problems.registry import make, names
+    if args.list:
+        print("\n".join(names()))
+        return 0
+
+    from explicit_hybrid_mpc_tpu.config import PartitionConfig
+    from explicit_hybrid_mpc_tpu.oracle.oracle import Oracle
+    from explicit_hybrid_mpc_tpu.partition.frontier import FrontierEngine
+    from explicit_hybrid_mpc_tpu.utils.logging import RunLog
+
+    problem = make(args.example, **_parse_problem_args(args.problem_arg))
+    prefix = args.output
+    os.makedirs(os.path.dirname(prefix) or ".", exist_ok=True)
+    eps_a = args.eps_a if args.eps_a is not None else (
+        1e-2 if args.eps_r is None else 0.0)
+    cfg = PartitionConfig(
+        problem=args.example, eps_a=eps_a,
+        eps_r=args.eps_r if args.eps_r is not None else 0.0,
+        algorithm=args.algorithm, backend=args.backend,
+        batch_simplices=args.batch, max_depth=args.max_depth,
+        max_steps=args.max_steps,
+        checkpoint_every=args.checkpoint_every,
+        checkpoint_path=(f"{prefix}.ckpt.pkl"
+                         if args.checkpoint_every else None),
+        log_path=f"{prefix}.log.jsonl")
+
+    mesh = None
+    if args.mesh:
+        from explicit_hybrid_mpc_tpu.parallel import make_mesh
+        mesh = make_mesh((args.mesh, 1))
+    backend = "device" if args.backend == "tpu" else args.backend
+    oracle = Oracle(problem, backend=backend, mesh=mesh)
+    log = RunLog(cfg.log_path, echo=True)
+    if args.resume:
+        eng = FrontierEngine.resume(args.resume, problem, oracle, log)
+    else:
+        eng = FrontierEngine(problem, oracle, cfg, log)
+    res = eng.run()
+
+    res.tree.save(f"{prefix}.tree.pkl")
+    with open(f"{prefix}.stats.json", "w") as f:
+        json.dump(res.stats, f, indent=2)
+    print(json.dumps(res.stats), file=sys.stderr)
+
+    if args.simulate:
+        import numpy as np
+
+        from explicit_hybrid_mpc_tpu.online import export
+        from explicit_hybrid_mpc_tpu.sim import simulator
+
+        table = export.export_leaves(res.tree)
+        theta0 = 0.8 * problem.theta_ub
+        cmp = simulator.compare(problem, table, oracle, theta0,
+                                T=args.simulate)
+        sim_stats = {
+            "theta0": np.asarray(theta0).tolist(),
+            "explicit_cost": cmp.explicit.total_cost,
+            "implicit_cost": cmp.implicit.total_cost,
+            "cost_ratio": cmp.cost_ratio,
+            "explicit_us_per_step": cmp.explicit.mean_eval_us,
+            "implicit_us_per_step": cmp.implicit.mean_eval_us,
+            "online_speedup": cmp.speedup,
+        }
+        with open(f"{prefix}.sim.json", "w") as f:
+            json.dump(sim_stats, f, indent=2)
+        print(json.dumps(sim_stats), file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

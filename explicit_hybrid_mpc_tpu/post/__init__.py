@@ -1,0 +1,2 @@
+from explicit_hybrid_mpc_tpu.post.analysis import (  # noqa: F401
+    load_runlog, partition_report, runtime_report)

@@ -1,0 +1,87 @@
+"""Partition and runtime statistics (post-processing layer).
+
+The reference's post-processing computes partition statistics and runtime
+histograms from pickled outputs for the paper's figures (SURVEY.md
+section 3 "Post-processing / figures" [M-med]; citation UNVERIFIED --
+reference mount empty).  Here: machine-readable reports from the Tree and
+the RunLog JSONL stream; figures live in post/figures.py.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+
+import numpy as np
+
+from explicit_hybrid_mpc_tpu.partition import geometry
+from explicit_hybrid_mpc_tpu.partition.tree import Tree
+
+
+def partition_report(tree: Tree, roots: list[int] | None = None) -> dict:
+    """Structural statistics of a built partition.
+
+    Volume accounting is exact (children tile their parent): certified +
+    infeasible + hole fractions sum to 1 over the root volume.  Holes are
+    leaves with no payload below the depth cap -- nonzero only for
+    truncated runs.
+    """
+    leaves = tree.leaves()
+    cert = [i for i in leaves if tree.leaf_data[i] is not None]
+    vol = {i: geometry.simplex_volume(tree.vertices[i]) for i in leaves}
+    roots = roots if roots is not None else [
+        i for i in range(len(tree)) if tree.parent[i] < 0]
+    total = sum(geometry.simplex_volume(tree.vertices[r]) for r in roots)
+    v_cert = sum(vol[i] for i in cert)
+    depths = np.asarray([tree.depth[i] for i in cert], dtype=np.int64)
+    per_delta = collections.Counter(
+        int(tree.leaf_data[i].delta_idx) for i in cert)
+    gaps = [float(np.ptp(tree.leaf_data[i].vertex_costs)) for i in cert]
+    return {
+        "n_nodes": len(tree),
+        "n_leaves": len(leaves),
+        "n_regions": len(cert),
+        "n_infeasible_or_hole": len(leaves) - len(cert),
+        "volume_total": total,
+        "volume_certified_frac": v_cert / total if total else 0.0,
+        "depth_min": int(depths.min()) if depths.size else 0,
+        "depth_max": int(depths.max()) if depths.size else 0,
+        "depth_mean": float(depths.mean()) if depths.size else 0.0,
+        "depth_hist": np.bincount(depths).tolist() if depths.size else [],
+        "regions_per_delta": dict(sorted(per_delta.items())),
+        "vertex_cost_spread_mean": float(np.mean(gaps)) if gaps else 0.0,
+    }
+
+
+def load_runlog(path: str) -> list[dict]:
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+def runtime_report(records: list[dict]) -> dict:
+    """Throughput statistics from a build's JSONL stream (regions/sec is
+    the north-star metric, SURVEY.md section 6.1)."""
+    steps = [r for r in records if "step" in r]
+    done = [r for r in records if r.get("done")]
+    if not steps:
+        return {"n_steps": 0}
+    t = np.asarray([r["t"] for r in steps])
+    regions = np.asarray([r.get("regions", 0) for r in steps])
+    solves = np.asarray([r.get("solves", 0) for r in steps])
+    frontier = np.asarray([r.get("frontier", 0) for r in steps])
+    dt = np.diff(np.concatenate([[0.0], t]))
+    out = {
+        "n_steps": len(steps),
+        "wall_s": float(t[-1]),
+        "regions_final": int(regions[-1]),
+        "regions_per_s_overall": float(regions[-1] / max(t[-1], 1e-9)),
+        "solves_final": int(solves[-1]),
+        "solves_per_s_overall": float(solves[-1] / max(t[-1], 1e-9)),
+        "frontier_peak": int(frontier.max()),
+        "step_seconds_mean": float(dt.mean()),
+        "step_seconds_p90": float(np.quantile(dt, 0.9)),
+    }
+    if done:
+        out["final_stats"] = {k: v for k, v in done[-1].items()
+                              if k not in ("t", "done")}
+    return out

@@ -332,6 +332,31 @@ class HybridMPC:
     def n_theta(self) -> int:
         return int(self.theta_lb.size)
 
+    # Stage-cost weights for closed-loop evaluation (sim/), set by
+    # subclasses alongside their canonical cost.  Shapes (n_x, n_x) and
+    # (n_u, n_u) in APPLIED-input coordinates.
+    Qc: np.ndarray
+    Rc: np.ndarray
+
+    def plant_step(self, x: np.ndarray, u: np.ndarray) -> np.ndarray:
+        """True plant update x+ = f(x, u) with the APPLIED input u (what
+        the online controller emits).  Used by the closed-loop simulator
+        (SURVEY.md section 4.3); the default raises so prediction-only
+        problems fail loudly."""
+        raise NotImplementedError(f"{self.name} defines no plant")
+
+    def theta_of_state(self, x: np.ndarray) -> np.ndarray:
+        """Partition parameter for plant state x (identity when the
+        parameter IS the state; slice problems override)."""
+        return np.asarray(x, dtype=np.float64)
+
+    def state_of_theta(self, theta: np.ndarray) -> np.ndarray:
+        """Initial plant state for parameter theta (identity default)."""
+        return np.asarray(theta, dtype=np.float64)
+
+    def stage_cost(self, x: np.ndarray, u: np.ndarray) -> float:
+        return float(0.5 * x @ self.Qc @ x + 0.5 * u @ self.Rc @ u)
+
     @functools.cached_property
     def canonical(self) -> CanonicalMPQP:
         can = self.build_canonical()

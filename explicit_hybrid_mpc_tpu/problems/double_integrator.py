@@ -6,6 +6,8 @@ delta-enumeration on this problem.
 
 from __future__ import annotations
 
+import functools
+
 import numpy as np
 
 from explicit_hybrid_mpc_tpu.problems import base
@@ -29,11 +31,21 @@ class DoubleIntegrator(base.HybridMPC):
         self.theta_lb = -theta_box * np.ones(2)
         self.theta_ub = theta_box * np.ones(2)
         self.n_u = 1
+        self.Qc = np.diag([1.0, 0.1])
+        self.Rc = np.array([[0.1]])
 
-    def build_canonical(self) -> base.CanonicalMPQP:
+    @functools.cache
+    def _plant(self):
         Ac = np.array([[0.0, 1.0], [0.0, 0.0]])
         Bc = np.array([[0.0], [1.0]])
-        A, B = base.zoh(Ac, Bc, self.dt)
+        return base.zoh(Ac, Bc, self.dt)
+
+    def plant_step(self, x, u):
+        A, B = self._plant()
+        return A @ x + B @ u
+
+    def build_canonical(self) -> base.CanonicalMPQP:
+        A, B = self._plant()
         N = self.N
         Q = np.diag([1.0, 0.1])
         R = np.array([[0.1]])

@@ -59,6 +59,16 @@ class InvertedPendulum(base.HybridMPC):
         # hyperplane in theta: root cells must align with it (see
         # geometry.box_triangulation).
         self.root_splits = {0: (0.0,)}
+        self.Qc = np.diag([4.0, 0.4])
+        self.Rc = np.array([[0.2]])
+
+    def plant_step(self, x, u):
+        """PWA plant: mode by the wall side of the CURRENT angle, matching
+        the prediction model's Euler discretization (build_canonical)."""
+        a_eff = self.a if x[0] <= 0.0 else self.a - self.ks
+        A = np.eye(2) + self.dt * np.array([[0.0, 1.0], [a_eff, 0.0]])
+        B = np.array([[0.5 * self.dt ** 2], [self.dt]])
+        return A @ x + B @ u
 
     def build_canonical(self) -> base.CanonicalMPQP:
         B_c = np.array([[0.0], [1.0]])

@@ -6,6 +6,8 @@ non-trivial.  Pure mp-QP (single commutation).
 
 from __future__ import annotations
 
+import functools
+
 import numpy as np
 
 from explicit_hybrid_mpc_tpu.problems import base
@@ -25,8 +27,11 @@ class MassSpring(base.HybridMPC):
         self.theta_lb = -theta_box * np.ones(4)
         self.theta_ub = theta_box * np.ones(4)
         self.n_u = 1
+        self.Qc = np.diag([1.0, 0.1, 1.0, 0.1])
+        self.Rc = np.array([[0.5]])
 
-    def build_canonical(self) -> base.CanonicalMPQP:
+    @staticmethod
+    def _continuous():
         # Two unit masses, springs k=1 wall-m1-m2, light damping.
         k, c = 1.0, 0.1
         Ac = np.array([
@@ -36,6 +41,18 @@ class MassSpring(base.HybridMPC):
             [k, 0.0, -k, -c],
         ])
         Bc = np.array([[0.0], [1.0], [0.0], [0.0]])
+        return Ac, Bc
+
+    @functools.cache
+    def _plant(self):
+        return base.zoh(*self._continuous(), self.dt)
+
+    def plant_step(self, x, u):
+        A, B = self._plant()
+        return A @ x + B @ u
+
+    def build_canonical(self) -> base.CanonicalMPQP:
+        Ac, Bc = self._continuous()
         A, B = base.zoh(Ac, Bc, self.dt)
         N = self.N
         Q = np.diag([1.0, 0.1, 1.0, 0.1])

@@ -41,6 +41,8 @@ a 12-box has 12! roots).
 
 from __future__ import annotations
 
+import functools
+
 import numpy as np
 
 from explicit_hybrid_mpc_tpu.problems import base
@@ -93,6 +95,9 @@ class Quadrotor(base.HybridMPC):
             self.theta_lb = -np.array([pos_box, pos_box])
         self.theta_ub = -self.theta_lb
         self.n_u = 4
+        self.Qc = np.diag([4.0, 4.0, 4.0, 1.0, 1.0, 1.0,
+                           2.0, 2.0, 2.0, 0.5, 0.5, 0.5])
+        self.Rc = np.diag([0.1, 0.5, 0.5, 0.5])
         # Obstacle faces are fixed hyperplanes in (px, py); align root
         # cells so near-edge simplices certify at finite depth.
         xs, ys = set(), set()
@@ -107,6 +112,25 @@ class Quadrotor(base.HybridMPC):
         if ys:
             self.root_splits[1] = tuple(sorted(ys))
 
+    def plant_step(self, x, u):
+        Ad, Bd = self._discrete()
+        return Ad @ x + Bd @ u
+
+    def theta_of_state(self, x):
+        """Project the 12-state onto the partitioned slice.  The explicit
+        law is exact on the slice and an approximation off it (attitude
+        transients are treated as disturbances by the closed loop)."""
+        idx = [0, 1, 3, 4] if self.param == "pv" else [0, 1]
+        return np.asarray(x, dtype=np.float64)[idx]
+
+    def state_of_theta(self, theta):
+        x = np.zeros(12)
+        x[0], x[1] = theta[0], theta[1]
+        if self.param == "pv":
+            x[3], x[4] = theta[2], theta[3]
+        return x
+
+    @functools.cache
     def _discrete(self):
         g, m = self.g, self.mass
         A = np.zeros((12, 12))

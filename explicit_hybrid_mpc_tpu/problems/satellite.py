@@ -35,6 +35,7 @@ empty-interior equality rows that would degrade the IPM.
 
 from __future__ import annotations
 
+import functools
 import itertools
 
 import numpy as np
@@ -82,6 +83,22 @@ class Satellite(base.HybridMPC):
         self.theta_ub = -self.theta_lb
         self.n_u = 2 * axes   # applied (u_w, signed thruster torque)
         self.root_splits = None
+        self.Qc = np.diag(np.concatenate([np.full(axes, 50.0),
+                                          np.full(axes, 2.0)]))
+        self.Rc = np.diag(np.concatenate([np.full(axes, 1.0),
+                                          np.full(axes, 4.0)]))
+
+    @functools.cache
+    def _plant(self):
+        A_c, B_w_c, B_t_c = self._continuous()
+        return base.zoh(A_c, np.hstack([B_w_c, B_t_c]), self.dt)
+
+    def plant_step(self, x, u):
+        """u = (wheel torques, SIGNED thruster torques) -- the applied
+        input the online controller emits (u_selector folds the firing
+        sign into the magnitude channel)."""
+        A, B = self._plant()
+        return A @ x + B @ u
 
     def _continuous(self):
         """(A_c, B_w_c, B_t_unit_c): drift, wheel columns, unit-thrust

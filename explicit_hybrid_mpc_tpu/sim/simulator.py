@@ -1,0 +1,169 @@
+"""Closed-loop simulation: explicit PWA controller vs implicit MPC.
+
+The reference's simulator rolls the plant under the explicit controller
+and optionally compares against the implicit (online-solved) MPC at each
+step, recording trajectories and per-step evaluation times (SURVEY.md
+section 3 "Closed-loop simulator" [M-med] and section 4.3; citations
+UNVERIFIED -- reference mount empty).
+
+Controllers are callables theta -> (u, info).  Provided:
+
+- ExplicitController: the deployed artifact -- batched point location +
+  barycentric interpolation over the exported leaf table, pure-JAX or
+  Pallas backend (online/).
+- ImplicitController: the comparison baseline -- one full enumeration
+  oracle solve (the MICP) at the current parameter, i.e. what online MPC
+  would run without the offline partition.
+
+The explicit controller's certificate guarantees u within eps of optimal
+INSIDE the partitioned set; the simulator records the `inside` flag so
+excursions are visible rather than silent.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, NamedTuple
+
+import numpy as np
+
+from explicit_hybrid_mpc_tpu.oracle.oracle import Oracle
+from explicit_hybrid_mpc_tpu.online import evaluator
+from explicit_hybrid_mpc_tpu.online.export import LeafTable
+
+
+class StepInfo(NamedTuple):
+    eval_s: float
+    inside: bool
+    cost_pred: float     # controller's own cost claim (certified upper
+    #                      bound for explicit, V* for implicit); NaN if n/a
+
+
+class SimResult(NamedTuple):
+    states: np.ndarray      # (T+1, n_x)
+    inputs: np.ndarray      # (T, n_u)
+    stage_costs: np.ndarray  # (T,)
+    eval_s: np.ndarray      # (T,) per-step controller wall time
+    inside: np.ndarray      # (T,) bool
+    cost_pred: np.ndarray   # (T,)
+
+    @property
+    def total_cost(self) -> float:
+        return float(self.stage_costs.sum())
+
+    @property
+    def mean_eval_us(self) -> float:
+        return float(self.eval_s.mean() * 1e6)
+
+
+class ExplicitController:
+    """theta -> interpolated PWA law from a built partition."""
+
+    def __init__(self, table: LeafTable, backend: str = "jax"):
+        import jax.numpy as jnp
+
+        self._jnp = jnp
+        self.table = table
+        self.backend = backend
+        self.dev = evaluator.stage(table)
+        if backend == "pallas":
+            from explicit_hybrid_mpc_tpu.online import pallas_eval
+
+            self._pt = pallas_eval.stage_pallas(table)
+            self._eval = lambda th: pallas_eval.evaluate(
+                self._pt, self.dev, th)
+        elif backend == "jax":
+            self._eval = lambda th: evaluator.evaluate(self.dev, th)
+        else:
+            raise ValueError(f"unknown backend {backend!r}")
+        # Warm the jit cache: compile time must not pollute the per-step
+        # timing statistics (mean_eval_us feeds the online-speedup report).
+        p = table.bary_M.shape[1] - 1
+        self._eval(self._jnp.zeros((1, p)))
+
+    def __call__(self, theta: np.ndarray) -> tuple[np.ndarray, StepInfo]:
+        t0 = time.perf_counter()
+        out = self._eval(self._jnp.asarray(theta[None]))
+        u = np.asarray(out.u[0])
+        dt = time.perf_counter() - t0
+        return u, StepInfo(eval_s=dt, inside=bool(out.inside[0]),
+                           cost_pred=float(out.cost[0]))
+
+
+class ImplicitController:
+    """theta -> u from a full online enumeration solve (the baseline the
+    explicit law replaces; SURVEY.md section 4.3 'optionally also solve
+    implicit MICP')."""
+
+    def __init__(self, oracle: Oracle):
+        self.oracle = oracle
+        # Warm the single-point jit bucket (timing parity with
+        # ExplicitController's warmup).
+        n_solves = oracle.n_solves
+        oracle.solve_vertices(np.zeros((1, oracle.can.n_theta)))
+        oracle.n_solves = n_solves
+        oracle.n_point_solves -= oracle.can.n_delta
+
+    def __call__(self, theta: np.ndarray) -> tuple[np.ndarray, StepInfo]:
+        t0 = time.perf_counter()
+        sol = self.oracle.solve_vertices(theta[None])
+        dt = time.perf_counter() - t0
+        feasible = sol.dstar[0] >= 0
+        u = (sol.u0[0, sol.dstar[0]] if feasible
+             else np.zeros(self.oracle.can.n_u))
+        return np.asarray(u), StepInfo(
+            eval_s=dt, inside=bool(feasible),
+            cost_pred=float(sol.Vstar[0]))
+
+
+def simulate(problem, controller: Callable, theta0: np.ndarray,
+             T: int, noise: np.ndarray | None = None) -> SimResult:
+    """Roll problem.plant_step under `controller` for T steps from
+    parameter theta0.  noise: optional (T, n_x) additive state
+    disturbance sequence (pass a pre-drawn array for reproducibility)."""
+    x = problem.state_of_theta(np.asarray(theta0, dtype=np.float64))
+    states = [x]
+    inputs, costs, infos = [], [], []
+    for k in range(T):
+        u, info = controller(problem.theta_of_state(x))
+        x = problem.plant_step(x, u)
+        if noise is not None:
+            x = x + noise[k]
+        states.append(x)
+        inputs.append(u)
+        costs.append(problem.stage_cost(states[-2], u))
+        infos.append(info)
+    return SimResult(
+        states=np.stack(states), inputs=np.stack(inputs),
+        stage_costs=np.asarray(costs),
+        eval_s=np.asarray([i.eval_s for i in infos]),
+        inside=np.asarray([i.inside for i in infos]),
+        cost_pred=np.asarray([i.cost_pred for i in infos]))
+
+
+class Comparison(NamedTuple):
+    explicit: SimResult
+    implicit: SimResult
+
+    @property
+    def cost_ratio(self) -> float:
+        """Closed-loop explicit cost / implicit cost (1 = parity; the
+        certificate bounds the OPEN-loop gap, so this is the honest
+        closed-loop check)."""
+        return self.explicit.total_cost / max(self.implicit.total_cost,
+                                              1e-300)
+
+    @property
+    def speedup(self) -> float:
+        return self.implicit.mean_eval_us / max(
+            self.explicit.mean_eval_us, 1e-12)
+
+
+def compare(problem, table: LeafTable, oracle: Oracle, theta0: np.ndarray,
+            T: int, backend: str = "jax",
+            noise: np.ndarray | None = None) -> Comparison:
+    """Same initial condition and noise under both controllers."""
+    exp = simulate(problem, ExplicitController(table, backend=backend),
+                   theta0, T, noise)
+    imp = simulate(problem, ImplicitController(oracle), theta0, T, noise)
+    return Comparison(explicit=exp, implicit=imp)

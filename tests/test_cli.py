@@ -1,0 +1,55 @@
+"""CLI surface: flag parsing, build outputs, resume path, error cases."""
+
+import json
+import os
+
+import pytest
+
+from explicit_hybrid_mpc_tpu.main import build_parser, main
+
+
+def test_list(capsys):
+    assert main(["--list", "-e", "x"]) == 0
+    out = capsys.readouterr().out
+    assert "double_integrator" in out and "quadrotor" in out
+
+
+def test_build_and_outputs(tmp_path):
+    prefix = str(tmp_path / "out" / "di")
+    rc = main(["-e", "double_integrator", "-a", "0.2", "--backend", "cpu",
+               "--batch", "64", "-o", prefix,
+               "--problem-arg", "N=3", "--problem-arg", "theta_box=1.5",
+               "--simulate", "10"])
+    assert rc == 0
+    assert os.path.exists(f"{prefix}.tree.pkl")
+    stats = json.load(open(f"{prefix}.stats.json"))
+    assert stats["regions"] > 0 and not stats["truncated"]
+    assert os.path.exists(f"{prefix}.log.jsonl")
+    sim = json.load(open(f"{prefix}.sim.json"))
+    assert sim["cost_ratio"] < 1.1
+
+
+def test_feasible_variant(tmp_path):
+    prefix = str(tmp_path / "feas")
+    rc = main(["-e", "double_integrator", "--algorithm", "feasible",
+               "--backend", "cpu", "-o", prefix,
+               "--problem-arg", "N=3", "--problem-arg", "theta_box=1.5"])
+    assert rc == 0
+    stats = json.load(open(f"{prefix}.stats.json"))
+    assert stats["regions"] > 0
+
+
+def test_bad_example():
+    with pytest.raises(KeyError):
+        main(["-e", "not_a_problem", "-a", "0.1", "--backend", "cpu"])
+
+
+def test_parser_rejects_bad_algorithm():
+    with pytest.raises(SystemExit):
+        build_parser().parse_args(["-e", "x", "--algorithm", "bogus"])
+
+
+def test_bad_problem_arg():
+    with pytest.raises(SystemExit):
+        main(["-e", "double_integrator", "-a", "0.1", "--backend", "cpu",
+              "--problem-arg", "oops"])

@@ -1,0 +1,80 @@
+"""Post-processing: partition stats invariants, runlog reports, figures."""
+
+import numpy as np
+import pytest
+
+from explicit_hybrid_mpc_tpu.config import PartitionConfig
+from explicit_hybrid_mpc_tpu.partition.frontier import build_partition
+from explicit_hybrid_mpc_tpu.post import (load_runlog, partition_report,
+                                          runtime_report)
+from explicit_hybrid_mpc_tpu.problems.registry import make
+
+
+@pytest.fixture(scope="module")
+def built(tmp_path_factory):
+    log = str(tmp_path_factory.mktemp("post") / "run.jsonl")
+    prob = make("inverted_pendulum", N=3)
+    cfg = PartitionConfig(problem="inverted_pendulum", eps_a=0.5,
+                          backend="cpu", batch_simplices=64,
+                          max_steps=400, log_path=log)
+    res = build_partition(prob, cfg)
+    return prob, res, log
+
+
+def test_partition_report_invariants(built):
+    prob, res, _ = built
+    rep = partition_report(res.tree, res.roots)
+    assert rep["n_regions"] == res.stats["regions"]
+    assert rep["n_nodes"] == len(res.tree)
+    # Certified volume fraction: complete non-truncated hybrid build may
+    # keep infeasible cells, but coverage must be substantial and <= 1.
+    assert 0.5 < rep["volume_certified_frac"] <= 1.0 + 1e-9
+    assert rep["depth_max"] == res.stats["max_depth"]
+    assert sum(rep["depth_hist"]) == rep["n_regions"]
+    # Both PWA modes appear among leaf commutations.
+    assert len(rep["regions_per_delta"]) >= 2
+
+
+def test_volume_exactly_tiles_for_pure_qp():
+    """Single-commutation problem: every leaf certifies, so certified
+    volume == root volume exactly."""
+    prob = make("double_integrator", N=3, theta_box=1.5)
+    cfg = PartitionConfig(eps_a=0.3, backend="cpu", batch_simplices=64)
+    res = build_partition(prob, cfg)
+    rep = partition_report(res.tree, res.roots)
+    np.testing.assert_allclose(rep["volume_certified_frac"], 1.0,
+                               rtol=1e-9)
+
+
+def test_runtime_report(built):
+    _, res, log = built
+    recs = load_runlog(log)
+    rep = runtime_report(recs)
+    assert rep["n_steps"] == res.stats["steps"]
+    assert rep["regions_final"] == res.stats["regions"]
+    assert rep["regions_per_s_overall"] > 0
+    assert rep["final_stats"]["regions"] == res.stats["regions"]
+
+
+def test_figures_render(built, tmp_path):
+    prob, res, log = built
+    from explicit_hybrid_mpc_tpu.post import figures
+
+    f1 = figures.plot_partition_2d(res.tree,
+                                   save=str(tmp_path / "part.png"))
+    assert (tmp_path / "part.png").stat().st_size > 0
+    f2 = figures.plot_runtime(load_runlog(log),
+                              save=str(tmp_path / "rt.png"))
+    assert (tmp_path / "rt.png").stat().st_size > 0
+
+    from explicit_hybrid_mpc_tpu.online import export
+    from explicit_hybrid_mpc_tpu.sim import simulator
+
+    table = export.export_leaves(res.tree)
+    sim = simulator.simulate(prob, simulator.ExplicitController(table),
+                             np.array([0.3, 0.5]), T=10)
+    f3 = figures.plot_closed_loop({"explicit": sim},
+                                  save=str(tmp_path / "cl.png"))
+    assert (tmp_path / "cl.png").stat().st_size > 0
+    import matplotlib.pyplot as plt
+    plt.close("all")

@@ -1,0 +1,88 @@
+"""Closed-loop simulator: explicit-vs-implicit parity, regulation,
+hybrid plant switching, and noise handling."""
+
+import numpy as np
+import pytest
+
+from explicit_hybrid_mpc_tpu.config import PartitionConfig
+from explicit_hybrid_mpc_tpu.online import export
+from explicit_hybrid_mpc_tpu.oracle.oracle import Oracle
+from explicit_hybrid_mpc_tpu.partition.frontier import build_partition
+from explicit_hybrid_mpc_tpu.problems.registry import make
+from explicit_hybrid_mpc_tpu.sim import simulator
+
+
+@pytest.fixture(scope="module")
+def di_setup():
+    prob = make("double_integrator", N=3, theta_box=1.5)
+    oracle = Oracle(prob, backend="cpu")
+    cfg = PartitionConfig(problem="double_integrator", eps_a=0.05,
+                          backend="cpu", batch_simplices=64)
+    res = build_partition(prob, cfg, oracle=oracle)
+    return prob, oracle, export.export_leaves(res.tree)
+
+
+def test_explicit_regulates_to_origin(di_setup):
+    prob, oracle, table = di_setup
+    res = simulator.simulate(
+        prob, simulator.ExplicitController(table),
+        np.array([1.0, -0.5]), T=40)
+    assert np.all(res.inside)
+    assert np.linalg.norm(res.states[-1]) < 1e-2
+    assert np.all(np.abs(res.inputs) <= prob.u_max + 1e-8)
+
+
+def test_explicit_tracks_implicit(di_setup):
+    """Closed-loop trajectories must agree within the certificate's
+    resolution (eps_a=0.05 -> near-identical inputs away from ties)."""
+    prob, oracle, table = di_setup
+    cmp = simulator.compare(prob, table, oracle,
+                            np.array([-1.2, 0.8]), T=30)
+    assert np.all(cmp.explicit.inside)
+    # Certified eps-suboptimality shows up as closed-loop cost parity.
+    assert cmp.cost_ratio < 1.05
+    err = np.max(np.abs(cmp.explicit.states - cmp.implicit.states))
+    assert err < 0.2  # same qualitative trajectory
+
+
+def test_pendulum_hybrid_switching(di_setup):
+    """Pendulum from inside the wall region: the plant must visit both
+    modes and the explicit law must still regulate."""
+    prob = make("inverted_pendulum", N=3)
+    oracle = Oracle(prob, backend="cpu")
+    cfg = PartitionConfig(problem="inverted_pendulum", eps_a=0.5,
+                          backend="cpu", batch_simplices=64, max_steps=400)
+    res = build_partition(prob, cfg, oracle=oracle)
+    table = export.export_leaves(res.tree)
+    sim = simulator.simulate(
+        prob, simulator.ExplicitController(table),
+        np.array([0.3, 0.5]), T=60)
+    th = sim.states[:, 0]
+    assert np.any(th > 0) and np.any(th < 0)   # both modes visited
+    assert np.linalg.norm(sim.states[-1]) < 0.05
+
+
+def test_noise_and_cost_accounting(di_setup, rng):
+    prob, oracle, table = di_setup
+    noise = 0.01 * rng.normal(size=(20, 2))
+    res = simulator.simulate(
+        prob, simulator.ExplicitController(table),
+        np.array([0.5, 0.5]), T=20, noise=noise)
+    assert res.states.shape == (21, 2)
+    assert res.stage_costs.shape == (20,)
+    assert res.total_cost > 0
+    # Stage costs recompute from the recorded trajectory.
+    c0 = prob.stage_cost(res.states[0], res.inputs[0])
+    assert np.isclose(c0, res.stage_costs[0])
+
+
+def test_satellite_closed_loop_desaturates():
+    """From saturated wheel momentum the closed loop must pull |h| down
+    (thruster firing), ending far below the start."""
+    prob = make("satellite", axes=1, N=3)
+    oracle = Oracle(prob, backend="cpu")
+    imp = simulator.simulate(
+        prob, simulator.ImplicitController(oracle),
+        np.array([0.0, 1.0]), T=25)
+    assert abs(imp.states[-1, 1]) < 0.3 * 1.0
+    assert np.all(np.isfinite(imp.inputs))

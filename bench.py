@@ -36,19 +36,34 @@ def main() -> None:
     from explicit_hybrid_mpc_tpu.partition.frontier import build_partition
     from explicit_hybrid_mpc_tpu.problems.registry import make, names
 
+    import os
+
+    # BENCH_PLATFORM=cpu forces the CPU backend (debugging / TPU-tunnel
+    # outage fallback).  Must run before the first device query; the env
+    # var JAX_PLATFORMS alone is overridden by the axon plugin
+    # (see .claude/skills/verify/SKILL.md gotchas).
+    if os.environ.get("BENCH_PLATFORM"):
+        jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
+
     platform = jax.default_backend()
     log(f"platform: {platform}, devices: {jax.devices()}")
 
     problem_name = ("inverted_pendulum" if "inverted_pendulum" in names()
                     else "double_integrator")
+    # BENCH_PROBLEM / BENCH_PRECISION env overrides for ablations.
+    problem_name = os.environ.get("BENCH_PROBLEM", problem_name)
+    precision = os.environ.get("BENCH_PRECISION", "mixed")
     problem = make(problem_name)
     eps_a = 1e-2
 
     # -- batched build on the default backend ------------------------------
+    # precision="mixed": f32 bulk + f64 polish to the same 1e-8 KKT
+    # tolerance (TPU f64 is emulated ~10x slower); the serial baseline
+    # below uses the SAME schedule, so the speedup isolates batching.
     cfg = PartitionConfig(problem=problem_name, eps_a=eps_a,
                           backend="device", batch_simplices=512,
-                          max_steps=5000)
-    oracle = Oracle(problem, backend="device")
+                          max_steps=5000, precision=precision)
+    oracle = Oracle(problem, backend="device", precision=precision)
     # Warm the jit caches so compile time is excluded: compile every
     # power-of-two vertex-batch bucket up front, then a tiny build for the
     # simplex-query programs.
@@ -80,7 +95,7 @@ def main() -> None:
     # actually issued.
     from explicit_hybrid_mpc_tpu.partition import geometry
 
-    serial = Oracle(problem, backend="serial")
+    serial = Oracle(problem, backend="serial", precision=precision)
     rng2 = np.random.default_rng(0)
     pts = rng2.uniform(problem.theta_lb, problem.theta_ub,
                        size=(8, problem.n_theta))
@@ -116,9 +131,41 @@ def main() -> None:
         f"{per_simplex*1e3:.2f} ms/simplex-solve x {n_simplex} -> est. "
         f"serial wall {serial_wall:.1f}s vs batched {stats['wall_s']:.1f}s")
 
+    # -- online PWA lookup (BASELINE.md metric 2) --------------------------
+    online_us = None
+    try:
+        import jax.numpy as jnp
+
+        from explicit_hybrid_mpc_tpu.online import (evaluator, export,
+                                                    pallas_eval)
+
+        table = export.export_leaves(res.tree)
+        dev = evaluator.stage(table)
+        pt = pallas_eval.stage_pallas(table)
+        rngq = np.random.default_rng(3)
+        B = 8192
+        qs = jnp.asarray(rngq.uniform(problem.theta_lb, problem.theta_ub,
+                                      size=(B, problem.n_theta)))
+        interp = platform == "cpu"   # Mosaic compiles on TPU only
+        out = pallas_eval.locate(pt, qs, interpret=interp)
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        reps = 10
+        for _ in range(reps):
+            out = pallas_eval.locate(pt, qs, interpret=interp)
+        jax.block_until_ready(out)
+        online_us = (time.perf_counter() - t0) / (reps * B) * 1e6
+        log(f"online: {online_us:.3f} us/query over {table.n_leaves} "
+            "leaves (pallas, incl host round-trip)")
+    except Exception as e:  # online metric is an extra, never fatal
+        log(f"online metric skipped: {e!r}")
+
+    extras = {}
+    if online_us is not None:
+        extras["online_us_per_query"] = round(online_us, 3)
     print(json.dumps({
         "metric": f"offline regions/sec ({problem_name}, eps_a={eps_a}, "
-                  f"{platform})",
+                  f"{platform}, {precision} precision)",
         "value": round(regions_per_s, 2),
         "unit": "regions/s",
         "vs_baseline": round(speedup, 2),
@@ -126,6 +173,7 @@ def main() -> None:
         "oracle_solves": stats["oracle_solves"],
         "wall_s": round(stats["wall_s"], 2),
         "serial_ms_per_solve": round(per_solve * 1e3, 3),
+        **extras,
     }))
 
 

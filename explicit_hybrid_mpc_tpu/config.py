@@ -50,6 +50,10 @@ class PartitionConfig:
     log_path: Optional[str] = None
     # Mesh axis size for sharding the solve batch (None = all local devices).
     mesh_devices: Optional[int] = None
+    # IPM precision schedule: 'f64' (every iteration in emulated-on-TPU
+    # float64) or 'mixed' (f32 bulk + f64 polish to the same KKT
+    # tolerance; ~3x less f64 work -- the TPU-fast path).
+    precision: str = "f64"
     seed: int = 0
 
     def __post_init__(self) -> None:

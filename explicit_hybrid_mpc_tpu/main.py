@@ -40,6 +40,9 @@ def build_parser() -> argparse.ArgumentParser:
                         "semi-explicit feasibility-only variant")
     p.add_argument("--backend", choices=("tpu", "cpu", "serial"),
                    default="tpu")
+    p.add_argument("--precision", choices=("f64", "mixed"), default="f64",
+                   help="IPM iteration precision: pure float64 vs "
+                        "f32-bulk + f64-polish (TPU-fast, same tolerance)")
     p.add_argument("--batch", type=int, default=256,
                    help="frontier simplices per device step")
     p.add_argument("--mesh", type=int, default=None, metavar="D",
@@ -103,14 +106,15 @@ def main(argv: list[str] | None = None) -> int:
         checkpoint_every=args.checkpoint_every,
         checkpoint_path=(f"{prefix}.ckpt.pkl"
                          if args.checkpoint_every else None),
-        log_path=f"{prefix}.log.jsonl")
+        log_path=f"{prefix}.log.jsonl", precision=args.precision)
 
     mesh = None
     if args.mesh:
         from explicit_hybrid_mpc_tpu.parallel import make_mesh
         mesh = make_mesh((args.mesh, 1))
     backend = "device" if args.backend == "tpu" else args.backend
-    oracle = Oracle(problem, backend=backend, mesh=mesh)
+    oracle = Oracle(problem, backend=backend, mesh=mesh,
+                    precision=args.precision)
     log = RunLog(cfg.log_path, echo=True)
     if args.resume:
         eng = FrontierEngine.resume(args.resume, problem, oracle, log)

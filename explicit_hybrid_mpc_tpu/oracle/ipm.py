@@ -56,39 +56,20 @@ def _fraction_to_boundary(v: jax.Array, dv: jax.Array, tau: float) -> jax.Array:
     return jnp.minimum(1.0, tau * jnp.min(ratio, axis=-1))
 
 
-def qp_solve(Q: jax.Array, q: jax.Array, A: jax.Array, b: jax.Array,
-             n_iter: int = 30, tol: float = 1e-8) -> QPSolution:
-    """Solve one dense convex QP with Mehrotra predictor-corrector.
-
-    Shapes: Q (nz,nz) PD, q (nz,), A (nc,nz), b (nc,).  vmap freely.
-    """
+def _make_body(Q, q, A, b):
+    """One Mehrotra predictor-corrector step in the arrays' dtype."""
     nz = Q.shape[-1]
     nc = A.shape[-2]
     dtype = Q.dtype
-    reg = jnp.asarray(1e-10, dtype)
-
-    # Initial point: unconstrained minimizer, unit slacks/duals shifted to
-    # cover the initial primal infeasibility (standard Mehrotra start).
-    Lq = jnp.linalg.cholesky(Q + reg * jnp.eye(nz, dtype=dtype))
-    z0 = -jax.scipy.linalg.cho_solve((Lq, True), q)
-    resid0 = A @ z0 - b
-    shift = jnp.maximum(1.0, 1.1 * jnp.max(jnp.maximum(resid0, 0.0)))
-    s0 = jnp.maximum(b - A @ z0, 0.0) + shift
-    # `vary` carries the union of the inputs' varying-manual-axes type so
-    # the fori_loop carry is vma-stable under shard_map (all inputs are
-    # finite by canonicalization, so the product is exactly zero).
-    vary = 0.0 * (jnp.sum(Q) + jnp.sum(q) + jnp.sum(A) + jnp.sum(b))
-    z0 = z0 + vary
-    s0 = s0 + vary
-    lam0 = jnp.ones(nc, dtype=dtype) + vary
-
-    scale_p = 1.0 + jnp.max(jnp.abs(b))
-    scale_d = 1.0 + jnp.max(jnp.abs(q))
+    # f32 factorizations need a heavier ridge than f64 to survive the
+    # terminal D = lam/s blow-up.
+    reg = jnp.asarray(1e-10 if dtype == jnp.float64 else 1e-7, dtype)
+    tiny = _TINY if dtype == jnp.float64 else 1e-8
 
     def body(_, carry):
         z, s, lam = carry
-        s = jnp.maximum(s, _TINY)
-        lam = jnp.maximum(lam, _TINY)
+        s = jnp.maximum(s, tiny)
+        lam = jnp.maximum(lam, tiny)
         r_d = Q @ z + q + A.T @ lam
         r_p = A @ z + s - b
         mu = jnp.dot(s, lam) / nc
@@ -120,7 +101,62 @@ def qp_solve(Q: jax.Array, q: jax.Array, A: jax.Array, b: jax.Array,
         a_d = _fraction_to_boundary(lam, dlam, 0.995)
         return (z + a_p * dz, s + a_p * ds, lam + a_d * dlam)
 
-    z, s, lam = jax.lax.fori_loop(0, n_iter, body, (z0, s0, lam0))
+    return body
+
+
+def qp_solve(Q: jax.Array, q: jax.Array, A: jax.Array, b: jax.Array,
+             n_iter: int = 30, tol: float = 1e-8,
+             n_f32: int = 0) -> QPSolution:
+    """Solve one dense convex QP with Mehrotra predictor-corrector.
+
+    Shapes: Q (nz,nz) PD, q (nz,), A (nc,nz), b (nc,).  vmap freely.
+
+    n_f32 > 0 enables the mixed-precision schedule (SURVEY.md section 8
+    "hard parts" item 2): n_f32 iterations run in float32 -- native-speed
+    MXU work on TPU, where f64 is emulated at ~10x cost -- then `n_iter`
+    float64 iterations polish from the warm start.  Near the central path
+    Mehrotra steps contract mu by >=1 digit/iteration, so ~6 f64 passes
+    recover full 1e-8 KKT accuracy; a diverged f32 phase (possible: its
+    Cholesky ridge is 1e-7) is detected and restarted from the f64 cold
+    start, so mixed is never WORSE than cold f64 with the same n_iter.
+    """
+    nz = Q.shape[-1]
+    nc = A.shape[-2]
+    dtype = Q.dtype
+    reg = jnp.asarray(1e-10, dtype)
+
+    # Initial point: unconstrained minimizer, unit slacks/duals shifted to
+    # cover the initial primal infeasibility (standard Mehrotra start).
+    Lq = jnp.linalg.cholesky(Q + reg * jnp.eye(nz, dtype=dtype))
+    z0 = -jax.scipy.linalg.cho_solve((Lq, True), q)
+    resid0 = A @ z0 - b
+    shift = jnp.maximum(1.0, 1.1 * jnp.max(jnp.maximum(resid0, 0.0)))
+    s0 = jnp.maximum(b - A @ z0, 0.0) + shift
+    # `vary` carries the union of the inputs' varying-manual-axes type so
+    # the fori_loop carry is vma-stable under shard_map (all inputs are
+    # finite by canonicalization, so the product is exactly zero).
+    vary = 0.0 * (jnp.sum(Q) + jnp.sum(q) + jnp.sum(A) + jnp.sum(b))
+    z0 = z0 + vary
+    s0 = s0 + vary
+    lam0 = jnp.ones(nc, dtype=dtype) + vary
+
+    scale_p = 1.0 + jnp.max(jnp.abs(b))
+    scale_d = 1.0 + jnp.max(jnp.abs(q))
+
+    start = (z0, s0, lam0)
+    if n_f32 > 0:
+        f32 = jnp.float32
+        body32 = _make_body(Q.astype(f32), q.astype(f32),
+                            A.astype(f32), b.astype(f32))
+        warm32 = jax.lax.fori_loop(
+            0, n_f32, body32, tuple(c.astype(f32) for c in start))
+        warm = tuple(c.astype(dtype) for c in warm32)
+        ok = jnp.all(jnp.asarray(
+            [jnp.all(jnp.isfinite(c)) for c in warm]))
+        start = tuple(jnp.where(ok, w, c) for w, c in zip(warm, start))
+
+    body = _make_body(Q, q, A, b)
+    z, s, lam = jax.lax.fori_loop(0, n_iter, body, start)
 
     r_p = jnp.max(jnp.abs(A @ z + s - b)) / scale_p
     r_d = jnp.max(jnp.abs(Q @ z + q + A.T @ lam)) / scale_d
@@ -137,7 +173,7 @@ def qp_solve(Q: jax.Array, q: jax.Array, A: jax.Array, b: jax.Array,
 
 
 def phase1(A: jax.Array, b: jax.Array, n_iter: int = 30,
-           rho: float = 1e-4) -> jax.Array:
+           rho: float = 1e-4, n_f32: int = 0) -> jax.Array:
     """Minimal constraint violation t* = min max(A z - b) (smoothed).
 
     Solves min_z,t 1/2 rho t^2 + t  s.t.  A z - t <= b, a strictly feasible
@@ -154,5 +190,5 @@ def phase1(A: jax.Array, b: jax.Array, n_iter: int = 30,
     Q = Q.at[nz, nz].set(rho)
     q = jnp.zeros(nz + 1, dtype=dtype).at[nz].set(1.0)
     At = jnp.concatenate([A, -jnp.ones((nc, 1), dtype=dtype)], axis=1)
-    sol = qp_solve(Q, q, At, b, n_iter=n_iter)
+    sol = qp_solve(Q, q, At, b, n_iter=n_iter, n_f32=n_f32)
     return sol.z[nz]

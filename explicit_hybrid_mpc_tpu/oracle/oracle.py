@@ -76,12 +76,14 @@ class VertexSolution(NamedTuple):
     dstar: np.ndarray    # (P,) argmin commutation; -1 if none valid
 
 
-def _solve_one(prob: DeviceProblem, theta: jax.Array, d: int, n_iter: int):
+def _solve_one(prob: DeviceProblem, theta: jax.Array, d: int, n_iter: int,
+               n_f32: int = 0):
     """Fixed-commutation QP at one point: P_theta_delta in reference terms
     (SURVEY.md section 3, UNVERIFIED naming)."""
     q = prob.f[d] + prob.F[d] @ theta
     b = prob.w[d] + prob.S[d] @ theta
-    sol = ipm.qp_solve(prob.H[d], q, prob.G[d], b, n_iter=n_iter)
+    sol = ipm.qp_solve(prob.H[d], q, prob.G[d], b, n_iter=n_iter,
+                       n_f32=n_f32)
     theta_cost = (0.5 * theta @ prob.Y[d] @ theta + prob.pvec[d] @ theta
                   + prob.cconst[d])
     V = sol.obj + theta_cost
@@ -92,7 +94,8 @@ def _solve_one(prob: DeviceProblem, theta: jax.Array, d: int, n_iter: int):
     return V, sol.converged, grad, u0, sol.z
 
 
-def _solve_points_grid(prob: DeviceProblem, thetas: jax.Array, n_iter: int):
+def _solve_points_grid(prob: DeviceProblem, thetas: jax.Array, n_iter: int,
+                       n_f32: int = 0):
     """(P points) x (nd commutations) raw grid solve, no reduction.
 
     The delta reduction is split out so parallel/mesh.py can shard the grid
@@ -103,7 +106,8 @@ def _solve_points_grid(prob: DeviceProblem, thetas: jax.Array, n_iter: int):
 
     def per_point(theta):
         return jax.vmap(
-            lambda d: _solve_one(prob, theta, d, n_iter))(jnp.arange(nd))
+            lambda d: _solve_one(prob, theta, d, n_iter,
+                                 n_f32))(jnp.arange(nd))
 
     return jax.vmap(per_point)(thetas)
 
@@ -121,15 +125,15 @@ def reduce_deltas(V: jax.Array, conv: jax.Array):
 
 
 def _solve_points_all_deltas(prob: DeviceProblem, thetas: jax.Array,
-                             n_iter: int):
+                             n_iter: int, n_f32: int = 0):
     """(P points) x (nd commutations) in one vmapped program."""
-    V, conv, grad, u0, z = _solve_points_grid(prob, thetas, n_iter)
+    V, conv, grad, u0, z = _solve_points_grid(prob, thetas, n_iter, n_f32)
     Vstar, dstar = reduce_deltas(V, conv)
     return V, conv, grad, u0, z, Vstar, dstar
 
 
 def _simplex_feas_one(prob: DeviceProblem, bary_M: jax.Array, d: int,
-                      n_iter: int):
+                      n_iter: int, n_f32: int = 0):
     """Joint phase-1 over a simplex: t* = min violation of commutation d's
     constraints over {(z, theta) : theta in R}.
 
@@ -163,7 +167,7 @@ def _simplex_feas_one(prob: DeviceProblem, bary_M: jax.Array, d: int,
     Q = jnp.eye(nz + nt + 1, dtype=dtype) * 1e-9
     Q = Q.at[nz + nt, nz + nt].set(1e-6)
     q = jnp.zeros(nz + nt + 1, dtype=dtype).at[nz + nt].set(1.0)
-    sol = ipm.qp_solve(Q, q, A, b, n_iter=n_iter)
+    sol = ipm.qp_solve(Q, q, A, b, n_iter=n_iter, n_f32=n_f32)
     # Farkas check on the ORIGINAL system A0 x <= b (t column dropped).
     A0 = A[:, :nz + nt]
     y = sol.lam / jnp.maximum(jnp.sum(sol.lam), 1e-300)
@@ -174,7 +178,7 @@ def _simplex_feas_one(prob: DeviceProblem, bary_M: jax.Array, d: int,
 
 
 def _solve_simplex_min_one(prob: DeviceProblem, bary_M: jax.Array,
-                           d: int, n_iter: int):
+                           d: int, n_iter: int, n_f32: int = 0):
     """Exact min_{theta in R} V_delta(theta): joint QP over (z, theta).
 
     bary_M is the (p+1, p+1) barycentric matrix of the simplex (lambda =
@@ -196,7 +200,7 @@ def _solve_simplex_min_one(prob: DeviceProblem, bary_M: jax.Array,
     Gj = jnp.block([[prob.G[d], -prob.S[d]],
                     [jnp.zeros((M_th.shape[0], nz), dtype=dtype), -M_th]])
     bj = jnp.concatenate([prob.w[d], m_c])
-    sol = ipm.qp_solve(Hj, qj, Gj, bj, n_iter=n_iter)
+    sol = ipm.qp_solve(Hj, qj, Gj, bj, n_iter=n_iter, n_f32=n_f32)
     return sol.obj + prob.cconst[d], sol.converged, sol.feasible
 
 
@@ -204,15 +208,26 @@ class Oracle:
     """Solver plugin boundary with selectable backend."""
 
     def __init__(self, problem, backend: str = "cpu", n_iter: int = 30,
-                 mesh=None):
+                 mesh=None, precision: str = "f64"):
         """mesh: optional jax.sharding.Mesh with ("batch", "delta") axes;
         when given, solve_vertices shards the (points x commutations) grid
         over it (parallel/mesh.py) instead of running on a single device --
-        the TPU-native counterpart of adding MPI worker ranks."""
+        the TPU-native counterpart of adding MPI worker ranks.
+
+        precision: 'f64' = every IPM iteration in float64 (emulated and
+        ~10x slow on TPU); 'mixed' = 20 float32 iterations (native MXU
+        speed) + 10 warm-started float64 polish iterations, reaching the
+        same 1e-8 KKT tolerance (ipm.qp_solve docstring; SURVEY.md
+        section 8 "hard parts" item 2).  Both backends of a parity
+        comparison must use the SAME precision."""
         self.problem = problem
         self.can = problem.canonical
         self.backend = backend
-        self.n_iter = n_iter
+        if precision not in ("f64", "mixed"):
+            raise ValueError(f"unknown precision {precision!r}")
+        self.precision = precision
+        self.n_f32 = 20 if precision == "mixed" else 0
+        self.n_iter = 10 if precision == "mixed" else n_iter
         self.mesh = mesh
         # Statistics: individual QP solves issued, split by kind -- the
         # point QPs (fixed-commutation solves at a parameter point) and
@@ -238,36 +253,41 @@ class Oracle:
         if mesh is not None:
             from explicit_hybrid_mpc_tpu.parallel.mesh import MeshSolver
             self._mesh_solver = MeshSolver(to_device(self.can), mesh,
-                                           n_iter=n_iter)
+                                           n_iter=self.n_iter,
+                                           n_f32=self.n_f32)
 
         self._solve_points = jax.jit(
-            functools.partial(_solve_points_all_deltas, n_iter=self.n_iter),
+            functools.partial(_solve_points_all_deltas, n_iter=self.n_iter,
+                              n_f32=self.n_f32),
             static_argnames=())
         self._solve_one_point = jax.jit(
             lambda prob, theta: _solve_points_all_deltas(
-                prob, theta[None], self.n_iter))
+                prob, theta[None], self.n_iter, self.n_f32))
         self._simplex_min = jax.jit(
             jax.vmap(lambda M, d: _solve_simplex_min_one(
-                self.prob, M, d, self.n_iter), in_axes=(0, 0)))
+                self.prob, M, d, self.n_iter, self.n_f32), in_axes=(0, 0)))
         self._simplex_feas = jax.jit(
             jax.vmap(lambda M, d: _simplex_feas_one(
-                self.prob, M, d, self.n_iter), in_axes=(0, 0)))
+                self.prob, M, d, self.n_iter, self.n_f32), in_axes=(0, 0)))
         self._point_feas = jax.jit(
             jax.vmap(lambda th, d: ipm.phase1(
                 self.prob.G[d],
                 self.prob.w[d] + self.prob.S[d] @ th,
-                n_iter=self.n_iter), in_axes=(0, 0)))
+                n_iter=self.n_iter, n_f32=self.n_f32), in_axes=(0, 0)))
 
     # -- the MICP-at-a-point query (reference: P_theta) --------------------
 
     @property
     def max_points_per_call(self) -> int:
         """Point-batch cap per device program: bounds the (points x
-        commutations) grid to ~2^16 simultaneous QP solves (memory: the
-        kernel materializes one (nz, nz) Cholesky per grid cell) and caps
-        the number of distinct padded shapes XLA ever compiles."""
+        commutations) grid to ~2^16 simultaneous QP solves (2^15 for
+        mixed precision, whose two-phase program is ~2x the compiled
+        code) -- memory (one (nz, nz) Cholesky per grid cell), compile
+        size, and the number of distinct padded shapes XLA ever
+        compiles."""
         nd = max(1, self.can.n_delta)
-        cap = 1 << max(3, (65536 // nd).bit_length() - 1)
+        budget = 65536 if self.n_f32 == 0 else 32768
+        cap = 1 << max(3, (budget // nd).bit_length() - 1)
         return min(2048, cap)
 
     def solve_vertices(self, thetas: np.ndarray) -> VertexSolution:

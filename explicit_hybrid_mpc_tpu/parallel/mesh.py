@@ -73,7 +73,7 @@ def _replicate_pad_deltas(prob: DeviceProblem, n_delta_shards: int
     return DeviceProblem(*reps), nd
 
 
-def sharded_grid_solver(mesh: Mesh, n_iter: int):
+def sharded_grid_solver(mesh: Mesh, n_iter: int, n_f32: int = 0):
     """Build the sharded (points x deltas) solver for `mesh`.
 
     Returns ``fn(prob, thetas, delta_mask) -> (V, conv, grad, u0, z,
@@ -94,7 +94,8 @@ def sharded_grid_solver(mesh: Mesh, n_iter: int):
     """
 
     def local(prob, thetas, delta_mask):
-        V, conv, grad, u0, z = _solve_points_grid(prob, thetas, n_iter)
+        V, conv, grad, u0, z = _solve_points_grid(prob, thetas, n_iter,
+                                                  n_f32)
         conv = conv & delta_mask[None, :]
         return V, conv, grad, u0, z
 
@@ -112,7 +113,8 @@ class MeshSolver:
     contract, but the work is sharded over `mesh`.
     """
 
-    def __init__(self, prob: DeviceProblem, mesh: Mesh, n_iter: int = 30):
+    def __init__(self, prob: DeviceProblem, mesh: Mesh, n_iter: int = 30,
+                 n_f32: int = 0):
         from jax.sharding import NamedSharding
 
         self.mesh = mesh
@@ -126,7 +128,7 @@ class MeshSolver:
         nd_pad = self.prob.H.shape[0]
         self.delta_mask = jax.device_put(jnp.arange(nd_pad) < self.nd,
                                          NamedSharding(mesh, P("delta")))
-        grid = sharded_grid_solver(mesh, n_iter)
+        grid = sharded_grid_solver(mesh, n_iter, n_f32)
 
         def staged(prob, thetas, delta_mask):
             V, conv, grad, u0, z = grid(prob, thetas, delta_mask)

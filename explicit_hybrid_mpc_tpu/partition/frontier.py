@@ -279,6 +279,7 @@ class FrontierEngine:
 def build_partition(problem, cfg: PartitionConfig,
                     oracle: Oracle | None = None) -> PartitionResult:
     """One-call offline build: problem + config -> certified partition."""
-    oracle = oracle or Oracle(problem, backend=cfg.backend)
+    oracle = oracle or Oracle(problem, backend=cfg.backend,
+                              precision=cfg.precision)
     log = RunLog(cfg.log_path, echo=False)
     return FrontierEngine(problem, oracle, cfg, log).run()

@@ -42,6 +42,11 @@ class PartitionConfig:
     max_depth: int = 40
     # Maximum number of frontier steps.
     max_steps: int = 10_000
+    # Wall-clock budget for the build loop in seconds (None = unlimited).
+    # Exceeding it stops cleanly after the current step with
+    # stats['truncated']=True -- the benchmark capture's guarantee that a
+    # number is produced on ANY platform within the capture window.
+    time_budget_s: Optional[float] = None
     # Snapshot the frontier + tree every N steps (0 disables).  SURVEY.md
     # section 6.4: build obligation "frontier checkpointing".
     checkpoint_every: int = 0
@@ -50,6 +55,11 @@ class PartitionConfig:
     log_path: Optional[str] = None
     # Mesh axis size for sharding the solve batch (None = all local devices).
     mesh_devices: Optional[int] = None
+    # jax.profiler trace output directory (None disables).  The first
+    # `profile_steps` frontier steps are traced -- SURVEY.md section 6.1's
+    # tracing obligation (device utilization, f64-emulation hotspots).
+    profile_path: Optional[str] = None
+    profile_steps: int = 5
     # IPM precision schedule: 'f64' (every iteration in emulated-on-TPU
     # float64) or 'mixed' (f32 bulk + f64 polish to the same KKT
     # tolerance; ~3x less f64 work -- the TPU-fast path).

@@ -61,6 +61,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--problem-arg", action="append", default=[],
                    metavar="K=V", help="problem constructor overrides, "
                    "e.g. --problem-arg N=5 --problem-arg axes=1")
+    p.add_argument("--profile", metavar="DIR", default=None,
+                   help="write a jax.profiler trace of the first "
+                        "--profile-steps frontier steps to DIR")
+    p.add_argument("--profile-steps", type=int, default=5)
     p.add_argument("--list", action="store_true",
                    help="list registered problems and exit")
     return p
@@ -87,6 +91,16 @@ def main(argv: list[str] | None = None) -> int:
         print("\n".join(names()))
         return 0
 
+    if args.backend in ("cpu", "serial"):
+        # Pin the platform BEFORE the first device query: with the TPU
+        # plugin registered, jax.devices("cpu") still initializes every
+        # backend, and a dead TPU tunnel then hangs a pure-CPU run.
+        # (Env JAX_PLATFORMS alone is overridden by the plugin's own
+        # config.update -- see .claude/skills/verify/SKILL.md gotchas.)
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
     from explicit_hybrid_mpc_tpu.config import PartitionConfig
     from explicit_hybrid_mpc_tpu.oracle.oracle import Oracle
     from explicit_hybrid_mpc_tpu.partition.frontier import FrontierEngine
@@ -106,18 +120,47 @@ def main(argv: list[str] | None = None) -> int:
         checkpoint_every=args.checkpoint_every,
         checkpoint_path=(f"{prefix}.ckpt.pkl"
                          if args.checkpoint_every else None),
-        log_path=f"{prefix}.log.jsonl", precision=args.precision)
+        log_path=f"{prefix}.log.jsonl", precision=args.precision,
+        profile_path=args.profile, profile_steps=args.profile_steps)
+
+    snapshot = None
+    if args.resume:
+        # SOLVER flags (precision/backend/eps/batch...) come from the
+        # snapshot: silently mixing CLI values into a half-built partition
+        # would change solver behaviour mid-build with no record.  OUTPUT
+        # flags (log/checkpoint/profile paths) stay with THIS run's -o
+        # prefix -- a resumed build must not append to the old run's log
+        # or overwrite its checkpoint.  Loaded once; FrontierEngine.resume
+        # reuses the dict (the snapshot holds the whole tree + cache).
+        import dataclasses
+        import pickle
+
+        with open(args.resume, "rb") as f:
+            snapshot = pickle.load(f)
+        snap_cfg = snapshot["cfg"]
+        for fld in ("eps_a", "eps_r", "algorithm", "backend", "precision",
+                    "batch_simplices", "max_depth", "max_steps"):
+            cli_v, snap_v = getattr(cfg, fld), getattr(snap_cfg, fld)
+            if cli_v != snap_v:
+                print(f"resume: using snapshot {fld}={snap_v!r} "
+                      f"(CLI value {cli_v!r} ignored)", file=sys.stderr)
+        cfg = dataclasses.replace(
+            snap_cfg, log_path=cfg.log_path,
+            checkpoint_every=cfg.checkpoint_every,
+            checkpoint_path=cfg.checkpoint_path,
+            profile_path=cfg.profile_path,
+            profile_steps=cfg.profile_steps)
 
     mesh = None
     if args.mesh:
         from explicit_hybrid_mpc_tpu.parallel import make_mesh
         mesh = make_mesh((args.mesh, 1))
-    backend = "device" if args.backend == "tpu" else args.backend
+    backend = "device" if cfg.backend == "tpu" else cfg.backend
     oracle = Oracle(problem, backend=backend, mesh=mesh,
-                    precision=args.precision)
+                    precision=cfg.precision)
     log = RunLog(cfg.log_path, echo=True)
     if args.resume:
-        eng = FrontierEngine.resume(args.resume, problem, oracle, log)
+        eng = FrontierEngine.resume(snapshot, problem, oracle, log, cfg=cfg)
     else:
         eng = FrontierEngine(problem, oracle, cfg, log)
     res = eng.run()
@@ -135,8 +178,11 @@ def main(argv: list[str] | None = None) -> int:
 
         table = export.export_leaves(res.tree)
         theta0 = 0.8 * problem.theta_ub
+        # Feasibility-only partitions deploy semi-explicitly: the leaf
+        # fixes delta and a small convex QP runs online (SURVEY.md 4.2).
         cmp = simulator.compare(problem, table, oracle, theta0,
-                                T=args.simulate)
+                                T=args.simulate,
+                                semi_explicit=cfg.algorithm == "feasible")
         sim_stats = {
             "theta0": np.asarray(theta0).tolist(),
             "explicit_cost": cmp.explicit.total_cost,

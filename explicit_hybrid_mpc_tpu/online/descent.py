@@ -1,0 +1,138 @@
+"""O(depth) device tree-descent point location.
+
+The brute-force locate (online/evaluator.py, online/pallas_eval.py)
+touches every leaf per query -- O(L) HBM traffic, the right trade at
+10^3-10^4 leaves where one fused contraction wins.  The reference's online
+stage is an O(depth) tree descent (SURVEY.md section 4.2 [P]); this module
+is its device-native counterpart for LARGE partitions: the tree's internal
+nodes export as flat split-hyperplane arrays and the descent runs as a
+fixed-trip-count `fori_loop` of gathers, one hyperplane sign test per
+level.  scripts/online_crossover.py measures the brute-vs-descent
+crossover; see artifacts/online_crossover.json.
+
+Geometry: a longest-edge bisection's two children are separated by the
+hyperplane through the shared face = {edge midpoint} u {the p-1 unsplit
+vertices}.  Sign convention: h(x) = w.x - c <= 0 on the LEFT child (the
+child that kept vertex i of the split edge (i, j), left = V with V[j]
+replaced by the midpoint -- partition/geometry.bisect).
+
+Root location is a brute-force min-barycentric argmax over the ROOTS only
+(at most p! per sub-box, tiny next to the leaf count).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from explicit_hybrid_mpc_tpu.online.evaluator import (DeviceLeafTable,
+                                                      EvalResult)
+from explicit_hybrid_mpc_tpu.online.export import LeafTable
+from explicit_hybrid_mpc_tpu.partition import geometry
+from explicit_hybrid_mpc_tpu.partition.tree import NO_CHILD, Tree
+
+
+class DescentTable(NamedTuple):
+    """Flat device arrays for the descent locate."""
+
+    root_bary: jax.Array  # (R, p+1, p+1) root barycentric matrices
+    root_node: jax.Array  # (R,) i32 tree node id per root
+    children: jax.Array   # (Nn, 2) i32, NO_CHILD at leaves
+    normal: jax.Array     # (Nn, p) split hyperplane normal (internal nodes)
+    offset: jax.Array     # (Nn,) split hyperplane offset
+    leaf_row: jax.Array   # (Nn,) i32 row into the LeafTable; -1 elsewhere
+    max_depth: int
+
+
+def _split_hyperplane(V: np.ndarray, i: int, j: int
+                      ) -> tuple[np.ndarray, float]:
+    """Hyperplane through the shared child face of the (i, j) bisection,
+    oriented so h(V[i]) < 0 (left child side)."""
+    p = V.shape[1]
+    mid = 0.5 * (V[i] + V[j])
+    others = np.delete(V, (i, j), axis=0)          # (p-1, p)
+    if others.shape[0] == 0:                        # p == 1: point split
+        w = np.ones(1)
+    else:
+        # Normal = nullspace direction of the face's spanning vectors.
+        _, _, vt = np.linalg.svd(others - mid)
+        w = vt[-1]
+    c = float(w @ mid)
+    if float(w @ V[i]) > c:
+        w, c = -w, -c
+    n = np.linalg.norm(w)
+    return w / n, c / n
+
+
+def export_descent(tree: Tree, roots: list[int],
+                   table: LeafTable) -> DescentTable:
+    """Flatten a built tree into descent arrays (host, then staged)."""
+    Nn = len(tree)
+    p = tree.p
+    children = np.asarray(tree.children, dtype=np.int32)
+    normal = np.zeros((Nn, p))
+    offset = np.zeros(Nn)
+    for n in range(Nn):
+        if children[n, 0] == NO_CHILD:
+            continue
+        i, j = tree.split_edge[n]
+        normal[n], offset[n] = _split_hyperplane(tree.vertices[n], i, j)
+    leaf_row = np.full(Nn, -1, dtype=np.int32)
+    leaf_row[table.node_id] = np.arange(table.n_leaves, dtype=np.int32)
+    root_bary = np.stack([geometry.barycentric_matrix(tree.vertices[r])
+                          for r in roots])
+    return DescentTable(
+        root_bary=jnp.asarray(root_bary),
+        root_node=jnp.asarray(np.asarray(roots, dtype=np.int32)),
+        children=jnp.asarray(children),
+        normal=jnp.asarray(normal),
+        offset=jnp.asarray(offset),
+        leaf_row=jnp.asarray(leaf_row),
+        max_depth=int(tree.max_depth()))
+
+
+@functools.partial(jax.jit, static_argnames=())
+def locate_descent(table: DescentTable, thetas: jax.Array
+                   ) -> tuple[jax.Array, jax.Array]:
+    """Leaf-table row per query (i32 (B,)), plus the tree node id.
+
+    Row is -1 when the descent lands on a non-converged (infeasible /
+    hole) leaf.  Queries outside every root descend from the
+    best-matching root (callers read the evaluator's `inside` flag).
+    """
+    B = thetas.shape[0]
+    th1 = jnp.concatenate(
+        [thetas, jnp.ones((B, 1), thetas.dtype)], axis=1)
+    lam = jnp.einsum("rij,bj->bri", table.root_bary, th1)
+    best_root = jnp.argmax(jnp.min(lam, axis=-1), axis=-1)      # (B,)
+    node = table.root_node[best_root].astype(jnp.int32)
+
+    def body(_, node):
+        ch = table.children[node]                               # (B, 2)
+        h = (jnp.einsum("bp,bp->b", table.normal[node], thetas)
+             - table.offset[node])
+        nxt = jnp.where(h <= 0, ch[:, 0], ch[:, 1])
+        return jnp.where(ch[:, 0] == NO_CHILD, node, nxt)
+
+    node = jax.lax.fori_loop(0, table.max_depth, body, node)
+    return table.leaf_row[node], node
+
+
+def evaluate_descent(table: DescentTable, dev: DeviceLeafTable,
+                     thetas: jax.Array, tol: float = 1e-9) -> EvalResult:
+    """Descent-located, barycentric-interpolated PWA evaluation -- same
+    contract as online.evaluator.evaluate, O(depth) instead of O(L)."""
+    row, _node = locate_descent(table, thetas)
+    B = thetas.shape[0]
+    safe = jnp.maximum(row, 0)
+    th1 = jnp.concatenate(
+        [thetas, jnp.ones((B, 1), dev.bary_M.dtype)], axis=1)
+    lam = jnp.einsum("bij,bj->bi", dev.bary_M[safe], th1)
+    u = jnp.einsum("bi,bin->bn", lam, dev.U[safe])
+    cost = jnp.einsum("bi,bi->b", lam, dev.V[safe])
+    inside = (row >= 0) & (jnp.min(lam, axis=-1) >= -tol)
+    return EvalResult(u=u, cost=cost, leaf=safe, inside=inside)

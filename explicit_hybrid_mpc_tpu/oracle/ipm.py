@@ -114,11 +114,16 @@ def qp_solve(Q: jax.Array, q: jax.Array, A: jax.Array, b: jax.Array,
     n_f32 > 0 enables the mixed-precision schedule (SURVEY.md section 8
     "hard parts" item 2): n_f32 iterations run in float32 -- native-speed
     MXU work on TPU, where f64 is emulated at ~10x cost -- then `n_iter`
-    float64 iterations polish from the warm start.  Near the central path
-    Mehrotra steps contract mu by >=1 digit/iteration, so ~6 f64 passes
-    recover full 1e-8 KKT accuracy; a diverged f32 phase (possible: its
-    Cholesky ridge is 1e-7) is detected and restarted from the f64 cold
-    start, so mixed is never WORSE than cold f64 with the same n_iter.
+    float64 iterations polish from the warm start.  The f32 phase is traced
+    under matmul precision HIGHEST: TPU "f32" matmuls otherwise execute as
+    bf16 MXU passes (~1e-3 rel error), which would waste the phase.  Near
+    the central path Mehrotra steps contract mu by >=1 digit/iteration, so
+    ~6 f64 passes recover full 1e-8 KKT accuracy.  The warm start is
+    accepted only when its f64 KKT merit (max of scaled primal/dual
+    residual and complementarity) is no worse than the cold start's --
+    non-finite or merely finite-but-poor f32 phases (possible: the f32
+    Cholesky ridge is 1e-7) fall back to the cold start, so the polish
+    never starts from a point worse than cold f64 would.
     """
     nz = Q.shape[-1]
     nc = A.shape[-2]
@@ -146,13 +151,26 @@ def qp_solve(Q: jax.Array, q: jax.Array, A: jax.Array, b: jax.Array,
     start = (z0, s0, lam0)
     if n_f32 > 0:
         f32 = jnp.float32
-        body32 = _make_body(Q.astype(f32), q.astype(f32),
-                            A.astype(f32), b.astype(f32))
-        warm32 = jax.lax.fori_loop(
-            0, n_f32, body32, tuple(c.astype(f32) for c in start))
+        with jax.default_matmul_precision("highest"):
+            body32 = _make_body(Q.astype(f32), q.astype(f32),
+                                A.astype(f32), b.astype(f32))
+            warm32 = jax.lax.fori_loop(
+                0, n_f32, body32, tuple(c.astype(f32) for c in start))
         warm = tuple(c.astype(dtype) for c in warm32)
-        ok = jnp.all(jnp.asarray(
-            [jnp.all(jnp.isfinite(c)) for c in warm]))
+
+        def merit(carry):
+            """f64 KKT merit: max(scaled r_p, r_d, mu); NaN-safe (NaN
+            compares False, so a non-finite warm start is rejected)."""
+            zc, sc, lc = carry
+            sc = jnp.maximum(sc, _TINY)
+            lc = jnp.maximum(lc, _TINY)
+            mrp = jnp.max(jnp.abs(A @ zc + sc - b)) / scale_p
+            mrd = jnp.max(jnp.abs(Q @ zc + q + A.T @ lc)) / scale_d
+            mmu = jnp.dot(sc, lc) / nc / scale_d
+            return jnp.maximum(mrp, jnp.maximum(mrd, mmu))
+
+        m_warm = merit(warm)
+        ok = jnp.isfinite(m_warm) & (m_warm <= merit(start))
         start = tuple(jnp.where(ok, w, c) for w, c in zip(warm, start))
 
     body = _make_body(Q, q, A, b)

@@ -208,15 +208,17 @@ class Oracle:
     """Solver plugin boundary with selectable backend."""
 
     def __init__(self, problem, backend: str = "cpu", n_iter: int = 30,
-                 mesh=None, precision: str = "f64"):
+                 mesh=None, precision: str = "f64",
+                 points_cap: int | None = None):
         """mesh: optional jax.sharding.Mesh with ("batch", "delta") axes;
         when given, solve_vertices shards the (points x commutations) grid
         over it (parallel/mesh.py) instead of running on a single device --
         the TPU-native counterpart of adding MPI worker ranks.
 
         precision: 'f64' = every IPM iteration in float64 (emulated and
-        ~10x slow on TPU); 'mixed' = 20 float32 iterations (native MXU
-        speed) + 10 warm-started float64 polish iterations, reaching the
+        ~10x slow on TPU); 'mixed' = two-thirds of n_iter as float32
+        iterations (native MXU speed, matmul precision HIGHEST) + the
+        remaining third as warm-started float64 polish, reaching the
         same 1e-8 KKT tolerance (ipm.qp_solve docstring; SURVEY.md
         section 8 "hard parts" item 2).  Both backends of a parity
         comparison must use the SAME precision."""
@@ -226,8 +228,16 @@ class Oracle:
         if precision not in ("f64", "mixed"):
             raise ValueError(f"unknown precision {precision!r}")
         self.precision = precision
-        self.n_f32 = 20 if precision == "mixed" else 0
-        self.n_iter = 10 if precision == "mixed" else n_iter
+        # points_cap: optional hard ceiling on the point-batch bucket (see
+        # max_points_per_call).  Smaller caps mean smaller compiled
+        # programs and fewer jit buckets -- the CPU-fallback benchmark path
+        # uses this to bound compile time on slow platforms.
+        self.points_cap = points_cap
+        # Mixed precision splits the caller's iteration budget 2:1 between
+        # the f32 bulk and the f64 polish (default n_iter=30 -> 20 + 10);
+        # hard-coding the polish count would silently ignore n_iter.
+        self.n_f32 = (2 * n_iter) // 3 if precision == "mixed" else 0
+        self.n_iter = n_iter - self.n_f32
         self.mesh = mesh
         # Statistics: individual QP solves issued, split by kind -- the
         # point QPs (fixed-commutation solves at a parameter point) and
@@ -243,7 +253,13 @@ class Oracle:
             platform = "cpu"
         else:
             raise ValueError(f"unknown backend {backend!r}")
-        devs = jax.devices(platform) if platform else jax.devices()
+        # First ADDRESSABLE device: under multi-process jax.distributed,
+        # jax.devices()[0] can belong to another process, and device_put
+        # to a non-addressable device fails.  Single-point/simplex queries
+        # then run per-process (duplicated deterministic work); only the
+        # big vertex-grid solves shard over the global mesh.
+        devs = (jax.local_devices(backend=platform) if platform
+                else jax.local_devices())
         self.device = devs[0]
         self.prob = jax.device_put(to_device(self.can), self.device)
         self._mesh_solver = None
@@ -274,6 +290,10 @@ class Oracle:
                 self.prob.G[d],
                 self.prob.w[d] + self.prob.S[d] @ th,
                 n_iter=self.n_iter, n_f32=self.n_f32), in_axes=(0, 0)))
+        self._solve_fixed = jax.jit(
+            jax.vmap(lambda th, d: _solve_one(
+                self.prob, th, d, self.n_iter, self.n_f32),
+                in_axes=(0, 0)))
 
     # -- the MICP-at-a-point query (reference: P_theta) --------------------
 
@@ -288,7 +308,7 @@ class Oracle:
         nd = max(1, self.can.n_delta)
         budget = 65536 if self.n_f32 == 0 else 32768
         cap = 1 << max(3, (budget // nd).bit_length() - 1)
-        return min(2048, cap)
+        return min(self.points_cap or 2048, 2048, cap)
 
     def solve_vertices(self, thetas: np.ndarray) -> VertexSolution:
         """Solve the full enumeration at each point; pads the point batch
@@ -402,6 +422,39 @@ class Oracle:
         feas_somewhere = conv & (t <= 1e-6)
         infeas_cert = conv & (t > 1e-6) & farkas
         return t[:K], feas_somewhere[:K], infeas_cert[:K]
+
+    # -- fixed-commutation point solve (the semi-explicit ONLINE stage) ----
+
+    def solve_fixed(self, thetas: np.ndarray, delta_idx: np.ndarray
+                    ) -> tuple[np.ndarray, np.ndarray, np.ndarray,
+                               np.ndarray]:
+        """P_theta_delta at given (point, commutation) pairs.
+
+        This is the deployment-time query of the feasibility-only
+        ('feasible'/ECC) variant: the offline partition only fixes a
+        feasible commutation per leaf, and the online controller solves
+        this small fixed-delta convex QP at the current parameter
+        (SURVEY.md section 4.2: "the leaf instead fixes delta and solves a
+        small convex program online").
+
+        Returns (u0 (K, n_u), V (K,), converged (K,), z (K, nz)).
+        """
+        thetas = np.atleast_2d(np.asarray(thetas, dtype=np.float64))
+        K = thetas.shape[0]
+        if K == 0:
+            return (np.zeros((0, self.can.n_u)), np.zeros(0),
+                    np.zeros(0, dtype=bool), np.zeros((0, self.can.nz)))
+        self.n_solves += K
+        self.n_point_solves += K
+        Kpad = max(8, 1 << (K - 1).bit_length())
+        tpad = np.concatenate(
+            [thetas, np.zeros((Kpad - K, thetas.shape[1]))])
+        dpad = np.concatenate([np.asarray(delta_idx, dtype=np.int64),
+                               np.zeros(Kpad - K, dtype=np.int64)])
+        V, conv, _grad, u0, z = self._solve_fixed(jnp.asarray(tpad),
+                                                  jnp.asarray(dpad))
+        return (np.asarray(u0)[:K], np.asarray(V)[:K],
+                np.asarray(conv)[:K].astype(bool), np.asarray(z)[:K])
 
     # -- pointwise feasibility (phase-1) -----------------------------------
 

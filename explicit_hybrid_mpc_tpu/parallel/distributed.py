@@ -18,6 +18,7 @@ from __future__ import annotations
 from typing import Optional, Sequence
 
 import jax
+import numpy as np
 
 
 def init_distributed(coordinator_address: Optional[str] = None,
@@ -44,9 +45,48 @@ def init_distributed(coordinator_address: Optional[str] = None,
 
 
 def is_frontier_owner() -> bool:
-    """True on the process that owns the host-side frontier + tree
-    (process 0 -- the reference's scheduler rank)."""
+    """True on the process that owns checkpoint/output writing (process 0
+    -- the reference's scheduler rank).  NOTE the frontier STATE runs on
+    every process (deterministic lockstep, see stage_batch); only side
+    effects are owner-exclusive."""
     return jax.process_index() == 0
+
+
+def stage_batch(sharding, x: "np.ndarray"):
+    """Stage a host-global batch array for an SPMD solve step.
+
+    Single-process: a plain device_put (XLA splits it over local devices).
+    Multi-process: every process holds the SAME host-global `x` (the
+    frontier is replicated deterministic host state, the TPU-native
+    replacement for the reference's scheduler->worker branch messages);
+    each process contributes only the row-block its addressable devices
+    own, via `jax.make_array_from_process_local_data` -- no process ever
+    materializes another's device shards.
+    """
+    if jax.process_count() == 1:
+        return jax.device_put(x, sharding)
+    idx_map = sharding.addressable_devices_indices_map(x.shape)
+    starts = [s[0].start or 0 for s in idx_map.values()]
+    stops = [x.shape[0] if s[0].stop is None else s[0].stop
+             for s in idx_map.values()]
+    lo, hi = min(starts), max(stops)
+    if (hi - lo) * len(jax.devices()) != x.shape[0] * len(idx_map):
+        # Non-contiguous local rows (exotic device order): fall back to
+        # the callback API, which handles any layout.
+        return jax.make_array_from_callback(
+            x.shape, sharding, lambda idx: x[idx])
+    return jax.make_array_from_process_local_data(sharding, x[lo:hi],
+                                                  x.shape)
+
+
+def stage_replicated(sharding, x: "np.ndarray"):
+    """Stage host-global constants (problem matrices, masks) under a
+    sharding that may span non-addressable devices; device_put cannot do
+    that across processes, make_array_from_callback can."""
+    if jax.process_count() == 1:
+        return jax.device_put(x, sharding)
+    return jax.make_array_from_callback(
+        np.shape(x), sharding, lambda idx: np.asarray(x)[idx])
 
 
 def global_mesh(shape: Optional[Sequence[int]] = None):

@@ -117,17 +117,24 @@ class MeshSolver:
                  n_f32: int = 0):
         from jax.sharding import NamedSharding
 
+        from explicit_hybrid_mpc_tpu.parallel import distributed
+
         self.mesh = mesh
         self.n_batch = mesh.shape["batch"]
+        self.multiprocess = jax.process_count() > 1
         n_delta_shards = mesh.shape["delta"]
         prob, self.nd = _replicate_pad_deltas(prob, n_delta_shards)
         # Stage the (constant) problem arrays in their delta-sharded layout
         # once, so each solve call doesn't re-distribute them from the
-        # default device.
-        self.prob = jax.device_put(prob, NamedSharding(mesh, P("delta")))
+        # default device.  Across processes device_put cannot target
+        # non-addressable devices; distributed.stage_replicated can.
+        dsh = NamedSharding(mesh, P("delta"))
+        self.prob = DeviceProblem(*(distributed.stage_replicated(dsh, a)
+                                    for a in map(np.asarray, prob)))
         nd_pad = self.prob.H.shape[0]
-        self.delta_mask = jax.device_put(jnp.arange(nd_pad) < self.nd,
-                                         NamedSharding(mesh, P("delta")))
+        self.delta_mask = distributed.stage_replicated(
+            dsh, np.arange(nd_pad) < self.nd)
+        self._batch_sharding = NamedSharding(mesh, P("batch"))
         grid = sharded_grid_solver(mesh, n_iter, n_f32)
 
         def staged(prob, thetas, delta_mask):
@@ -135,7 +142,15 @@ class MeshSolver:
             Vstar, dstar = reduce_deltas(V, conv)
             return V, conv, grad, u0, z, Vstar, dstar
 
-        self._fn = jax.jit(staged)
+        if self.multiprocess:
+            # Every process runs the frontier in deterministic lockstep
+            # and needs the FULL result: replicate outputs (XLA inserts
+            # the all-gather over ICI/DCN) so np.asarray works on each
+            # process without application-level messaging.
+            rep = NamedSharding(mesh, P())
+            self._fn = jax.jit(staged, out_shardings=(rep,) * 7)
+        else:
+            self._fn = jax.jit(staged)
 
     def pad_batch(self, P_: int) -> int:
         """Static batch size: next power of two >= P_, rounded up to a
@@ -145,11 +160,14 @@ class MeshSolver:
         return -(-max(pow2, self.n_batch) // self.n_batch) * self.n_batch
 
     def __call__(self, thetas: np.ndarray):
+        from explicit_hybrid_mpc_tpu.parallel import distributed
+
         Pn = thetas.shape[0]
         Ppad = self.pad_batch(Pn)
         pad = np.zeros((Ppad - Pn, thetas.shape[1]))
-        out = self._fn(self.prob, jnp.asarray(np.concatenate([thetas, pad])),
-                       self.delta_mask)
+        xpad = np.concatenate([thetas, pad])
+        staged_in = distributed.stage_batch(self._batch_sharding, xpad)
+        out = self._fn(self.prob, staged_in, self.delta_mask)
         # Unpad points and (for per-delta outputs) padded commutations.
         V, conv, grad, u0, z, Vstar, dstar = out
         return (V[:Pn, :self.nd], conv[:Pn, :self.nd], grad[:Pn, :self.nd],

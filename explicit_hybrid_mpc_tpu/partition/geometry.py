@@ -134,8 +134,13 @@ def longest_edge(V: np.ndarray) -> tuple[int, int]:
     for i in range(n):
         for j in range(i + 1, n):
             d = float(np.dot(V[i] - V[j], V[i] - V[j]))
-            # Strict > keeps the lexicographically first pair on ties.
-            if d > best[0] + 1e-15:
+            # Strict > with a RELATIVE margin keeps the lexicographically
+            # first pair on ties at ANY scale: squared edge lengths shrink
+            # ~4x per bisection level, so an absolute epsilon would turn
+            # every comparison at depth >~ 20 into a "tie" and silently
+            # replace longest-edge (Rivara shape regularity) with
+            # lexicographic-first selection.
+            if d > best[0] * (1.0 + 1e-12):
                 best = (d, i, j)
     return best[1], best[2]
 

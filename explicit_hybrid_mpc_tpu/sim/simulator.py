@@ -59,7 +59,13 @@ class SimResult(NamedTuple):
 class ExplicitController:
     """theta -> interpolated PWA law from a built partition."""
 
-    def __init__(self, table: LeafTable, backend: str = "jax"):
+    def __init__(self, table: LeafTable, backend: str = "jax",
+                 interpret: bool | None = None, descent_table=None):
+        """interpret: Pallas interpret mode for backend='pallas'; None
+        auto-detects (True off-TPU, where Mosaic cannot compile).
+        backend='descent' uses the O(depth) tree-descent locate and needs
+        `descent_table` (online.descent.export_descent)."""
+        import jax
         import jax.numpy as jnp
 
         self._jnp = jnp
@@ -69,9 +75,18 @@ class ExplicitController:
         if backend == "pallas":
             from explicit_hybrid_mpc_tpu.online import pallas_eval
 
+            if interpret is None:
+                interpret = jax.default_backend() != "tpu"
             self._pt = pallas_eval.stage_pallas(table)
             self._eval = lambda th: pallas_eval.evaluate(
-                self._pt, self.dev, th)
+                self._pt, self.dev, th, interpret=interpret)
+        elif backend == "descent":
+            from explicit_hybrid_mpc_tpu.online import descent as _descent
+
+            if descent_table is None:
+                raise ValueError("backend='descent' needs descent_table")
+            self._eval = lambda th: _descent.evaluate_descent(
+                descent_table, self.dev, th)
         elif backend == "jax":
             self._eval = lambda th: evaluator.evaluate(self.dev, th)
         else:
@@ -88,6 +103,52 @@ class ExplicitController:
         dt = time.perf_counter() - t0
         return u, StepInfo(eval_s=dt, inside=bool(out.inside[0]),
                            cost_pred=float(out.cost[0]))
+
+
+class SemiExplicitController:
+    """Deployment of a feasibility-only ('feasible'/ECC) partition.
+
+    The offline stage only certifies a FEASIBLE commutation per leaf; the
+    intended online guarantee comes from solving the small fixed-delta
+    convex QP at the current parameter, not from interpolating vertex
+    inputs (SURVEY.md section 4.2 parenthetical: "the leaf instead fixes
+    delta and solves a small convex program online" -- semi-explicit).
+    Point location fixes delta; Oracle.solve_fixed supplies u.
+
+    Falls back to the interpolated vertex inputs only if the online QP
+    fails to converge (recorded via StepInfo.inside staying True but
+    cost_pred NaN would hide it, so the fallback flips `inside` False).
+    """
+
+    def __init__(self, table: LeafTable, oracle: Oracle,
+                 backend: str = "jax", interpret: bool | None = None):
+        self.oracle = oracle
+        self._loc = ExplicitController(table, backend=backend,
+                                       interpret=interpret)
+        self.table = table
+        # Warm the fixed-delta jit bucket (timing parity with the other
+        # controllers' warmup).
+        n = oracle.n_solves
+        oracle.solve_fixed(np.zeros((1, oracle.can.n_theta)),
+                           np.zeros(1, dtype=np.int64))
+        oracle.n_solves = n
+        oracle.n_point_solves -= 1
+
+    def __call__(self, theta: np.ndarray) -> tuple[np.ndarray, StepInfo]:
+        t0 = time.perf_counter()
+        out = self._loc._eval(self._loc._jnp.asarray(theta[None]))
+        leaf = int(out.leaf[0])
+        d = int(self.table.delta[leaf])
+        u0, V, conv, _z = self.oracle.solve_fixed(theta[None],
+                                                  np.array([d]))
+        dt = time.perf_counter() - t0
+        if conv[0]:
+            return u0[0], StepInfo(eval_s=dt, inside=bool(out.inside[0]),
+                                   cost_pred=float(V[0]))
+        # Online QP failed: interpolated law as best effort, flagged.
+        return (np.asarray(out.u[0]),
+                StepInfo(eval_s=dt, inside=False,
+                         cost_pred=float(out.cost[0])))
 
 
 class ImplicitController:
@@ -161,9 +222,20 @@ class Comparison(NamedTuple):
 
 def compare(problem, table: LeafTable, oracle: Oracle, theta0: np.ndarray,
             T: int, backend: str = "jax",
-            noise: np.ndarray | None = None) -> Comparison:
-    """Same initial condition and noise under both controllers."""
-    exp = simulate(problem, ExplicitController(table, backend=backend),
-                   theta0, T, noise)
+            noise: np.ndarray | None = None,
+            interpret: bool | None = None,
+            semi_explicit: bool = False) -> Comparison:
+    """Same initial condition and noise under both controllers.
+
+    semi_explicit=True deploys the feasibility-only variant's intended
+    online stage (leaf-fixed delta + small online QP) instead of the
+    interpolated PWA law."""
+    if semi_explicit:
+        ctrl = SemiExplicitController(table, oracle, backend=backend,
+                                      interpret=interpret)
+    else:
+        ctrl = ExplicitController(table, backend=backend,
+                                  interpret=interpret)
+    exp = simulate(problem, ctrl, theta0, T, noise)
     imp = simulate(problem, ImplicitController(oracle), theta0, T, noise)
     return Comparison(explicit=exp, implicit=imp)

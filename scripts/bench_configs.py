@@ -1,0 +1,121 @@
+"""All-configs benchmark capture: one measured row per BASELINE.md config.
+
+Round-1 verdict item 3: every BASELINE.md row needs a measured value, at
+BENCHMARK size -- satellite at its full 6-state axes=3 (720 Kuhn roots,
+27 commutations) and the quadrotor at its 4-D param="pv" slice (N=10, 16
+commutations), not the test-suite shrinks.  Builds that exceed the
+per-config wall budget are reported TRUNCATED with the certified-volume
+fraction from post.partition_report -- honest coverage, never a stall.
+
+Writes `artifacts/configs.json` (override: CONFIGS_OUT) with one row per
+config: regions, regions/sec, wall seconds, truncation state, certified /
+infeasible-or-hole volume fractions, cache peak.  Backend selection
+reuses bench.py's subprocess probe (dead TPU tunnel -> honest CPU rows).
+
+Env knobs: CONFIGS_OUT, CFG_TIME_BUDGET (s per config, default 600),
+CFG_PRECISION, CFG_ONLY (comma-separated subset of config names), plus
+bench.py's BENCH_PLATFORM / BENCH_PROBE_TIMEOUT.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from bench import choose_backend, log, warm_oracle  # noqa: E402
+
+# (BASELINE.md row, problem name, constructor kwargs, eps_a)
+CONFIGS = [
+    ("1. double integrator (2s, 1i, N=5)", "double_integrator", {}, 1e-2),
+    ("2. mass-spring mp-QP (4s, N=10)", "mass_spring", {}, 1e-2),
+    ("3. inverted pendulum PWA mp-MIQP", "inverted_pendulum", {}, 1e-2),
+    ("4. satellite desaturation (6s, 27 deltas)", "satellite",
+     {"axes": 3}, 1e-2),
+    ("5. quadrotor obstacle avoidance (4-D pv, 16 deltas)", "quadrotor",
+     {"param": "pv"}, 1e-2),
+]
+
+
+def main() -> int:
+    out_path = os.environ.get("CONFIGS_OUT", "artifacts/configs.json")
+    precision = os.environ.get("CFG_PRECISION", "mixed")
+    budget = float(os.environ.get("CFG_TIME_BUDGET", "600"))
+    only = os.environ.get("CFG_ONLY")
+    only_names = set(only.split(",")) if only else None
+
+    platform = choose_backend()
+    on_acc = platform != "cpu"
+
+    from explicit_hybrid_mpc_tpu.config import PartitionConfig
+    from explicit_hybrid_mpc_tpu.oracle.oracle import Oracle
+    from explicit_hybrid_mpc_tpu.partition.frontier import build_partition
+    from explicit_hybrid_mpc_tpu.post import analysis
+    from explicit_hybrid_mpc_tpu.problems.registry import make
+
+    result = {"captured_at": time.strftime("%Y-%m-%d %H:%M:%S"),
+              "platform": platform, "precision": precision,
+              "per_config_budget_s": budget, "rows": []}
+    for label, name, kwargs, eps_a in CONFIGS:
+        if only_names and name not in only_names:
+            continue
+        log(f"== {label} ==")
+        try:
+            problem = make(name, **kwargs)
+            oracle = Oracle(problem, backend="device" if on_acc else "cpu",
+                            precision=precision,
+                            points_cap=2048 if on_acc else 256)
+            # Warm the jit buckets (excluded from the timed build).
+            warm_oracle(oracle, problem)
+            warm_cfg = PartitionConfig(problem=name, eps_a=1.0,
+                                       backend="device",
+                                       batch_simplices=512, max_steps=30,
+                                       time_budget_s=120.0,
+                                       precision=precision)
+            build_partition(problem, warm_cfg, oracle=oracle)
+            oracle.n_solves = oracle.n_point_solves = 0
+            oracle.n_simplex_solves = 0
+
+            cfg = PartitionConfig(problem=name, eps_a=eps_a,
+                                  backend="device", batch_simplices=512,
+                                  max_steps=50_000, precision=precision,
+                                  time_budget_s=budget)
+            res = build_partition(problem, cfg, oracle=oracle)
+            stats = res.stats
+            report = analysis.partition_report(res.tree, res.roots)
+            row = {
+                "label": label, "problem": name, "kwargs": kwargs,
+                "eps_a": eps_a,
+                "n_theta": problem.n_theta,
+                "n_delta": problem.canonical.n_delta,
+                "regions": stats["regions"],
+                "regions_per_s": round(stats["regions_per_s"], 2),
+                "wall_s": round(stats["wall_s"], 2),
+                "truncated": stats["truncated"],
+                "frontier_left": stats["frontier_left"],
+                "uncertified": stats["uncertified"],
+                "max_depth": stats["max_depth"],
+                "oracle_solves": stats["oracle_solves"],
+                "cache_peak_mb": stats["cache_peak_mb"],
+                "volume_certified_frac": round(
+                    report["volume_certified_frac"], 6),
+            }
+            log(f"  -> {row}")
+        except Exception as e:  # one config must not void the others
+            import traceback
+
+            traceback.print_exc(file=sys.stderr)
+            row = {"label": label, "problem": name, "error": repr(e)}
+        result["rows"].append(row)
+        os.makedirs(os.path.dirname(out_path) or ".", exist_ok=True)
+        with open(out_path, "w") as f:  # write-through after every row
+            json.dump(result, f, indent=2)
+    print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

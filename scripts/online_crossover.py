@@ -1,0 +1,103 @@
+"""Measure online point-location cost vs leaf count: brute force vs
+descent (round-1 verdict item 7 -- the O(L)-vs-O(depth) crossover must be
+a measured artifact, not an assumption).
+
+Builds double-integrator partitions of increasing leaf count (shrinking
+eps_a), then times three locate+eval paths per partition over a fixed
+query batch:
+
+- `jax`:    pure-JAX brute force (one (B x L) contraction, O(L) HBM)
+- `pallas`: streaming Pallas kernel (TPU only; interpret-mode timing is
+            meaningless and skipped off-TPU)
+- `descent`: O(depth) hyperplane descent (online/descent.py)
+
+Writes `artifacts/online_crossover.json` with us/query per (leaf count,
+method).  Env: CROSS_OUT, CROSS_EPS (comma list), CROSS_BATCH,
+plus bench.py's BENCH_PLATFORM / BENCH_PROBE_TIMEOUT.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from bench import choose_backend, log  # noqa: E402
+
+
+def time_fn(fn, *args, reps: int = 20):
+    import jax
+
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / reps
+
+
+def main() -> int:
+    out_path = os.environ.get("CROSS_OUT", "artifacts/online_crossover.json")
+    eps_list = [float(e) for e in os.environ.get(
+        "CROSS_EPS", "0.5,0.2,0.1,0.05,0.02,0.01").split(",")]
+    B = int(os.environ.get("CROSS_BATCH", "4096"))
+
+    platform = choose_backend()
+    on_tpu = platform == "tpu"
+
+    import jax.numpy as jnp
+
+    from explicit_hybrid_mpc_tpu.config import PartitionConfig
+    from explicit_hybrid_mpc_tpu.online import (descent, evaluator, export,
+                                                pallas_eval)
+    from explicit_hybrid_mpc_tpu.oracle.oracle import Oracle
+    from explicit_hybrid_mpc_tpu.partition.frontier import build_partition
+    from explicit_hybrid_mpc_tpu.problems.registry import make
+
+    prob = make("double_integrator")
+    oracle = Oracle(prob, backend="device" if on_tpu else "cpu",
+                    precision="mixed", points_cap=2048 if on_tpu else 256)
+    rngq = np.random.default_rng(3)
+    qs = jnp.asarray(rngq.uniform(prob.theta_lb, prob.theta_ub,
+                                  size=(B, prob.n_theta)))
+    result = {"captured_at": time.strftime("%Y-%m-%d %H:%M:%S"),
+              "platform": platform, "batch": B, "rows": []}
+    for eps in eps_list:
+        cfg = PartitionConfig(problem="double_integrator", eps_a=eps,
+                              backend="device", batch_simplices=512,
+                              max_steps=20_000, precision="mixed",
+                              time_budget_s=600.0)
+        res = build_partition(prob, cfg, oracle=oracle)
+        table = export.export_leaves(res.tree)
+        dev = evaluator.stage(table)
+        dt = descent.export_descent(res.tree, res.roots, table)
+        row = {"eps_a": eps, "leaves": table.n_leaves,
+               "max_depth": dt.max_depth,
+               "truncated": res.stats["truncated"]}
+        row["jax_us"] = round(
+            time_fn(lambda q: evaluator.evaluate(dev, q), qs) / B * 1e6, 4)
+        row["descent_us"] = round(
+            time_fn(lambda q: descent.evaluate_descent(dt, dev, q), qs)
+            / B * 1e6, 4)
+        if on_tpu:
+            pt = pallas_eval.stage_pallas(table)
+            row["pallas_us"] = round(
+                time_fn(lambda q: pallas_eval.locate(pt, q), qs)
+                / B * 1e6, 4)
+        log(f"  {row}")
+        result["rows"].append(row)
+        os.makedirs(os.path.dirname(out_path) or ".", exist_ok=True)
+        with open(out_path, "w") as f:
+            json.dump(result, f, indent=2)
+    print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

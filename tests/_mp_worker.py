@@ -1,0 +1,60 @@
+"""Worker script for the 2-process jax.distributed localhost test.
+
+Each process initializes jax.distributed over localhost CPU devices,
+builds the same small double-integrator partition with the oracle's
+vertex-grid solves sharded over the GLOBAL (batch) mesh, and prints one
+JSON line with its view of the result.  The parent test asserts all
+processes agree with each other and with a single-process build --
+proving the frontier's multi-process staging path (SURVEY.md section 6.8)
+end to end without a cluster.
+
+Usage: python tests/_mp_worker.py PORT PROCESS_ID NUM_PROCESSES
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+port, pid, nproc = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+
+import re  # noqa: E402
+
+# Force exactly 4 virtual devices per process, replacing any count the
+# parent environment (e.g. the pytest conftest's 8) may have set.
+flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+               os.environ.get("XLA_FLAGS", ""))
+os.environ["XLA_FLAGS"] = (
+    flags + " --xla_force_host_platform_device_count=4").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.distributed.initialize(coordinator_address=f"localhost:{port}",
+                           num_processes=nproc, process_id=pid)
+assert jax.process_count() == nproc
+assert len(jax.devices()) == 4 * nproc, jax.devices()
+
+from explicit_hybrid_mpc_tpu.config import PartitionConfig  # noqa: E402
+from explicit_hybrid_mpc_tpu.oracle.oracle import Oracle  # noqa: E402
+from explicit_hybrid_mpc_tpu.parallel import (distributed,  # noqa: E402
+                                              make_mesh)
+from explicit_hybrid_mpc_tpu.partition.frontier import (  # noqa: E402
+    build_partition)
+from explicit_hybrid_mpc_tpu.problems.registry import make  # noqa: E402
+
+prob = make("double_integrator", N=3, theta_box=1.5)
+mesh = make_mesh((4 * nproc, 1))  # batch axis over ALL processes' devices
+oracle = Oracle(prob, backend="cpu", mesh=mesh)
+cfg = PartitionConfig(problem="double_integrator", eps_a=0.5,
+                      backend="cpu", batch_simplices=32, max_depth=20)
+res = build_partition(prob, cfg, oracle=oracle)
+print(json.dumps({
+    "pid": pid,
+    "owner": distributed.is_frontier_owner(),
+    "regions": res.stats["regions"],
+    "tree_nodes": res.stats["tree_nodes"],
+    "max_depth": res.stats["max_depth"],
+    "oracle_solves": res.stats["oracle_solves"],
+}), flush=True)

@@ -32,11 +32,62 @@ def test_build_and_outputs(tmp_path):
 def test_feasible_variant(tmp_path):
     prefix = str(tmp_path / "feas")
     rc = main(["-e", "double_integrator", "--algorithm", "feasible",
-               "--backend", "cpu", "-o", prefix,
+               "--backend", "cpu", "-o", prefix, "--simulate", "8",
                "--problem-arg", "N=3", "--problem-arg", "theta_box=1.5"])
     assert rc == 0
     stats = json.load(open(f"{prefix}.stats.json"))
     assert stats["regions"] > 0
+    # --simulate on a feasible-variant build must go through the
+    # semi-explicit controller (leaf delta + online QP) and stay sane.
+    sim = json.load(open(f"{prefix}.sim.json"))
+    assert sim["cost_ratio"] < 1.5
+
+
+def test_profile_flag_writes_trace_and_utilization(tmp_path):
+    """--profile writes a jax.profiler trace dir; the JSONL metrics carry
+    the device-utilization proxy (SURVEY.md section 6.1/6.5)."""
+    prefix = str(tmp_path / "pr")
+    trace = str(tmp_path / "trace")
+    rc = main(["-e", "double_integrator", "-a", "0.2", "--backend", "cpu",
+               "--batch", "32", "-o", prefix, "--profile", trace,
+               "--profile-steps", "2",
+               "--problem-arg", "N=3", "--problem-arg", "theta_box=1.5"])
+    assert rc == 0
+    files = [f for _, _, fs in os.walk(trace) for f in fs]
+    assert files, "profiler trace directory is empty"
+    lines = [json.loads(ln) for ln in open(f"{prefix}.log.jsonl")]
+    steps = [ln for ln in lines if "device_frac" in ln]
+    assert steps
+    assert all(0.0 <= ln["device_frac"] <= 1.01 for ln in steps)
+    assert all(ln["oracle_s"] <= ln["step_s"] + 1e-6 for ln in steps)
+
+
+def test_resume_uses_snapshot_cfg(tmp_path, capsys):
+    """A resumed build must take its solver flags from the snapshot, and
+    say so when the CLI disagrees (ADVICE round 1: CLI --precision could
+    silently switch solver precision mid-build)."""
+    prefix = str(tmp_path / "ck")
+    rc = main(["-e", "double_integrator", "-a", "0.2", "--backend", "cpu",
+               "--batch", "32", "-o", prefix, "--checkpoint-every", "1",
+               "--problem-arg", "N=3", "--problem-arg", "theta_box=1.5"])
+    assert rc == 0
+    ckpt = f"{prefix}.ckpt.pkl"
+    assert os.path.exists(ckpt)
+    prefix2 = str(tmp_path / "ck2")
+    rc = main(["-e", "double_integrator", "-a", "0.2", "--backend", "cpu",
+               "--precision", "mixed", "--batch", "64", "-o", prefix2,
+               "--resume", ckpt,
+               "--problem-arg", "N=3", "--problem-arg", "theta_box=1.5"])
+    assert rc == 0
+    err = capsys.readouterr().err
+    assert "resume: using snapshot precision='f64'" in err
+    assert "resume: using snapshot batch_simplices=32" in err
+    # Output paths belong to the NEW run: the resumed build writes its own
+    # log/stats under -o prefix2 and leaves the old run's log untouched.
+    assert os.path.exists(f"{prefix2}.log.jsonl")
+    assert os.path.exists(f"{prefix2}.stats.json")
+    old_log_size = os.path.getsize(f"{prefix}.log.jsonl")
+    assert old_log_size > 0  # written only by the first run
 
 
 def test_bad_example():

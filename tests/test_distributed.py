@@ -1,0 +1,84 @@
+"""Multi-process scale-out: 2 jax.distributed processes on localhost CPU
+drive the same frontier build with vertex-grid solves sharded over the
+GLOBAL device mesh (SURVEY.md section 6.8; round-1 verdict item 5 -- the
+multi-host path must stage process-local arrays and be tested, not be a
+pass-through stub)."""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("localhost", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_two_process_build_matches_single_process():
+    from explicit_hybrid_mpc_tpu.config import PartitionConfig
+    from explicit_hybrid_mpc_tpu.oracle.oracle import Oracle
+    from explicit_hybrid_mpc_tpu.partition.frontier import build_partition
+    from explicit_hybrid_mpc_tpu.problems.registry import make
+
+    # Reference: single-process build of the identical problem/config.
+    prob = make("double_integrator", N=3, theta_box=1.5)
+    cfg = PartitionConfig(problem="double_integrator", eps_a=0.5,
+                          backend="cpu", batch_simplices=32, max_depth=20)
+    ref = build_partition(prob, cfg, Oracle(prob, backend="cpu"))
+
+    port = _free_port()
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    procs = [subprocess.Popen(
+        [sys.executable, os.path.join("tests", "_mp_worker.py"),
+         str(port), str(i), "2"],
+        cwd=REPO, env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True) for i in range(2)]
+    outs = []
+    for p in procs:
+        try:
+            out, err = p.communicate(timeout=600)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("multi-process worker timed out")
+        assert p.returncode == 0, f"worker failed:\n{err[-2000:]}"
+        outs.append(json.loads(out.strip().splitlines()[-1]))
+
+    by_pid = {o["pid"]: o for o in outs}
+    assert by_pid[0]["owner"] and not by_pid[1]["owner"]
+    # Both processes ran the frontier in lockstep: identical trees.
+    for k in ("regions", "tree_nodes", "max_depth", "oracle_solves"):
+        assert by_pid[0][k] == by_pid[1][k], k
+    # And the distributed build matches the single-process ground truth.
+    assert by_pid[0]["regions"] == ref.stats["regions"]
+    assert by_pid[0]["tree_nodes"] == ref.stats["tree_nodes"]
+    assert by_pid[0]["max_depth"] == ref.stats["max_depth"]
+
+
+def test_stage_batch_single_process_roundtrip():
+    """stage_batch/stage_replicated: single-process path is a device_put
+    that the mesh solver consumes unchanged."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from explicit_hybrid_mpc_tpu.parallel import distributed, make_mesh
+
+    mesh = make_mesh((4, 2))
+    x = np.arange(32, dtype=np.float64).reshape(8, 4)
+    arr = distributed.stage_batch(NamedSharding(mesh, P("batch")), x)
+    np.testing.assert_array_equal(np.asarray(arr), x)
+    m = np.arange(6) < 4
+    rep = distributed.stage_replicated(NamedSharding(mesh, P("delta")), m)
+    np.testing.assert_array_equal(np.asarray(rep), m)
+    assert isinstance(arr, jax.Array)

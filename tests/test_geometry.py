@@ -52,6 +52,44 @@ def test_longest_edge_deterministic():
     assert g.longest_edge(V2) == (0, 1)
 
 
+def test_longest_edge_tiny_scale():
+    """Regression: the tie-break margin must be RELATIVE.  At deep-tree
+    scales every squared edge length is < 1e-10 and an absolute 1e-15
+    margin would call genuinely longer edges 'ties', silently replacing
+    longest-edge selection with lexicographic-first."""
+    s = 1e-8
+    V = np.array([[0.0, 0.0], [2 * s, 0.0], [0.0, 1 * s]])
+    # squared lengths: (0,1)=4s^2, (0,2)=1s^2, (1,2)=5s^2 -> longest (1,2).
+    assert g.longest_edge(V) == (1, 2)
+    # Exact ties still break lexicographic-first at tiny scale: edges
+    # (0,1) and (0,2) tie at s^2, (1,2) is the unique longest (2 s^2).
+    V2 = s * np.array([[0.0, 0.0], [1.0, 0.0], [0.0, 1.0]])
+    assert g.longest_edge(V2) == (1, 2)
+    # Degenerate all-tied case (equilateral at tiny scale): the
+    # lexicographically first pair wins, deterministically.
+    V3 = s * np.array([[0.0, 0.0], [1.0, 0.0], [0.5, np.sqrt(3) / 2]])
+    assert g.longest_edge(V3) == (0, 1)
+
+
+def test_deep_bisection_stays_shape_regular():
+    """Rivara longest-edge bisection keeps the aspect ratio bounded; with
+    the absolute-margin bug the selected edge stops being the longest
+    below ~1e-6 edge lengths and regularity degrades."""
+    V = np.array([[0.0, 0.0], [1.0, 0.0], [0.0, 1.0]])
+    for _ in range(100):
+        left, _right, i, j, _mid = g.bisect(V)
+        # The split edge is (within relative tolerance) a true longest.
+        d2 = [float(np.dot(V[a] - V[b], V[a] - V[b]))
+              for a in range(3) for b in range(a + 1, 3)]
+        split = float(np.dot(V[i] - V[j], V[i] - V[j]))
+        assert split >= max(d2) * (1 - 1e-9)
+        V = left
+    edges = [np.linalg.norm(V[a] - V[b])
+             for a in range(3) for b in range(a + 1, 3)]
+    assert max(edges) / min(edges) < 10.0  # bounded aspect ratio
+    assert max(edges) < 1e-14              # genuinely deep
+
+
 def test_kuhn_rejects_high_dim():
     with pytest.raises(ValueError):
         g.kuhn_triangulation(-np.ones(9), np.ones(9))

@@ -3,7 +3,7 @@ import numpy as np
 import pytest
 
 from explicit_hybrid_mpc_tpu.config import PartitionConfig
-from explicit_hybrid_mpc_tpu.online import evaluator, export
+from explicit_hybrid_mpc_tpu.online import descent, evaluator, export
 from explicit_hybrid_mpc_tpu.partition import geometry
 from explicit_hybrid_mpc_tpu.partition.frontier import build_partition
 from explicit_hybrid_mpc_tpu.problems.registry import make
@@ -49,6 +49,56 @@ def test_outside_flagged(built):
     dev = evaluator.stage(table)
     out = evaluator.evaluate(dev, jnp.asarray([[10.0, 10.0]]))
     assert not bool(out.inside[0])
+
+
+def test_descent_matches_brute_force(built, rng):
+    """The O(depth) device descent must agree with the O(L) brute-force
+    evaluator: located simplex contains the query and the interpolated
+    law matches (shared facets may differ in leaf id, never in value)."""
+    prob, res, table = built
+    dev = evaluator.stage(table)
+    dt = descent.export_descent(res.tree, res.roots, table)
+    assert dt.max_depth == res.tree.max_depth()
+    thetas = rng.uniform(prob.theta_lb, prob.theta_ub, size=(128, 2))
+    brute = evaluator.evaluate(dev, jnp.asarray(thetas))
+    desc = descent.evaluate_descent(dt, dev, jnp.asarray(thetas))
+    assert bool(np.all(np.asarray(desc.inside)))
+    np.testing.assert_allclose(np.asarray(desc.u), np.asarray(brute.u),
+                               atol=1e-8)
+    np.testing.assert_allclose(np.asarray(desc.cost),
+                               np.asarray(brute.cost), atol=1e-8)
+    # The located simplex geometrically contains each query.
+    rows, nodes = descent.locate_descent(dt, jnp.asarray(thetas))
+    for k, th in enumerate(thetas):
+        assert geometry.contains(res.tree.vertices[int(nodes[k])], th,
+                                 tol=1e-9)
+
+
+def test_descent_outside_flagged(built):
+    prob, res, table = built
+    dev = evaluator.stage(table)
+    dt = descent.export_descent(res.tree, res.roots, table)
+    out = descent.evaluate_descent(dt, dev, jnp.asarray([[10.0, 10.0]]))
+    assert not bool(out.inside[0])
+
+
+def test_descent_hybrid_partition(rng):
+    """Descent on a pendulum partition (pre-split roots, hybrid deltas):
+    values must match brute force everywhere inside."""
+    prob = make("inverted_pendulum", N=3)
+    cfg = PartitionConfig(problem="inverted_pendulum", eps_a=0.5,
+                          backend="cpu", batch_simplices=64, max_steps=400)
+    res = build_partition(prob, cfg)
+    table = export.export_leaves(res.tree)
+    dev = evaluator.stage(table)
+    dt = descent.export_descent(res.tree, res.roots, table)
+    thetas = rng.uniform(prob.theta_lb, prob.theta_ub, size=(64, 2))
+    brute = evaluator.evaluate(dev, jnp.asarray(thetas))
+    desc = descent.evaluate_descent(dt, dev, jnp.asarray(thetas))
+    ok = np.asarray(brute.inside) & np.asarray(desc.inside)
+    assert ok.mean() > 0.9  # infeasible margins may be flagged by either
+    np.testing.assert_allclose(np.asarray(desc.u)[ok],
+                               np.asarray(brute.u)[ok], atol=1e-8)
 
 
 def test_controller_is_continuous_across_facets(built, rng):

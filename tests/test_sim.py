@@ -62,6 +62,22 @@ def test_pendulum_hybrid_switching(di_setup):
     assert np.linalg.norm(sim.states[-1]) < 0.05
 
 
+def test_pallas_backend_matches_jax(di_setup):
+    """ExplicitController(backend='pallas') must produce the same closed
+    loop as the pure-JAX backend; interpret auto-detects off-TPU (ADVICE
+    round 1: the pallas sim path was TPU-only and untested)."""
+    prob, oracle, table = di_setup
+    theta0 = np.array([0.9, -0.4])
+    ref = simulator.simulate(
+        prob, simulator.ExplicitController(table, backend="jax"),
+        theta0, T=15)
+    pal = simulator.simulate(
+        prob, simulator.ExplicitController(table, backend="pallas"),
+        theta0, T=15)
+    np.testing.assert_allclose(pal.inputs, ref.inputs, atol=1e-6)
+    np.testing.assert_allclose(pal.states, ref.states, atol=1e-5)
+
+
 def test_noise_and_cost_accounting(di_setup, rng):
     prob, oracle, table = di_setup
     noise = 0.01 * rng.normal(size=(20, 2))
@@ -74,6 +90,45 @@ def test_noise_and_cost_accounting(di_setup, rng):
     # Stage costs recompute from the recorded trajectory.
     c0 = prob.stage_cost(res.states[0], res.inputs[0])
     assert np.isclose(c0, res.stage_costs[0])
+
+
+def test_semi_explicit_online_stage():
+    """The feasibility-only variant's intended deployment: locate fixes
+    the leaf's delta, a small fixed-delta QP runs online.  The emitted
+    input must come from a CONVERGED, constraint-satisfying QP at every
+    certified-leaf parameter (round-1 verdict: the interpolating evaluator
+    carries no guarantee for feasibility-only leaves)."""
+    prob = make("inverted_pendulum", N=3)
+    oracle = Oracle(prob, backend="cpu")
+    cfg = PartitionConfig(problem="inverted_pendulum",
+                          algorithm="feasible", backend="cpu",
+                          batch_simplices=64, max_steps=400)
+    res = build_partition(prob, cfg, oracle=oracle)
+    table = export.export_leaves(res.tree)
+    can = prob.canonical
+    tree = res.tree
+    rng = np.random.default_rng(7)
+    thetas, ds = [], []
+    leaves = tree.converged_leaves()
+    for n in leaves[::max(1, len(leaves) // 25)]:
+        lam = rng.dirichlet(np.ones(tree.vertices[n].shape[0]))
+        thetas.append(lam @ tree.vertices[n])
+        ds.append(tree.leaf_data[n].delta_idx)
+    u0, V, conv, z = oracle.solve_fixed(np.stack(thetas), np.array(ds))
+    # The offline certificate (delta feasible at every vertex => on the
+    # whole leaf, by convexity) makes the online QP feasible everywhere.
+    assert np.all(conv)
+    for k, (th, d) in enumerate(zip(thetas, ds)):
+        viol = np.max(can.G[d] @ z[k] - can.w[d] - can.S[d] @ th)
+        assert viol <= 1e-6, f"leaf sample {k}: violation {viol}"
+    # Closed loop under the semi-explicit controller regulates and
+    # respects input bounds.
+    sim = simulator.simulate(
+        prob, simulator.SemiExplicitController(table, oracle),
+        np.array([0.3, 0.5]), T=50)
+    assert np.linalg.norm(sim.states[-1]) < 0.05
+    assert np.all(np.abs(sim.inputs) <= prob.u_max + 1e-6)
+    assert np.all(sim.inside)
 
 
 def test_satellite_closed_loop_desaturates():

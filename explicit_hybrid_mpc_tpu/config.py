@@ -64,6 +64,12 @@ class PartitionConfig:
     # float64) or 'mixed' (f32 bulk + f64 polish to the same KKT
     # tolerance; ~3x less f64 work -- the TPU-fast path).
     precision: str = "f64"
+    # Inherit per-commutation stage-2 facts (Farkas infeasibility
+    # exclusions, simplex-min lower bounds) from parent to children across
+    # bisections.  Certified-exact decision parity with the uninherited
+    # build (frontier.py step(); tests/test_partition.py); False exists for
+    # that parity test and for debugging.
+    inherit_bounds: bool = True
     seed: int = 0
 
     def __post_init__(self) -> None:

@@ -116,6 +116,21 @@ class FrontierEngine:
         self._refcount: collections.Counter[bytes] = collections.Counter()
         for n in self.roots:
             self._retain(n)
+        # node -> {delta: lower bound on min_R V_delta} inherited from
+        # ancestors.  +inf = Farkas-certified infeasible on an ancestor
+        # simplex -- exact for every descendant (child subset of ancestor),
+        # so the (node, delta) stage-2 solve is skipped forever.  A finite
+        # value is the ancestor's exact simplex minimum: a valid (but
+        # possibly loose) lower bound on any child; it is used to attempt
+        # certification for free, and re-solved exactly only when the
+        # loose-bound certificate fails (round B below) -- which keeps the
+        # split/certify DECISIONS identical to an inheritance-free build
+        # (region-count parity by construction; tests/test_partition.py).
+        # BENCH_r02 measured 82% of all solves in stage-2 joint QPs,
+        # mostly re-proving the same delta' infeasible down entire
+        # subtrees; this inheritance removes that re-proving.
+        self._inherit: dict[int, dict[int, float]] = {}
+        self.n_inherited_skips = 0
 
     # -- device-failure fallback (SURVEY.md section 6.3) -------------------
 
@@ -217,6 +232,19 @@ class FrontierEngine:
         stage2: list[tuple[int, int]] = []  # (node, delta')
         sds: dict[int, certify.SimplexVertexData] = {}
         infeas_candidates: list[int] = []
+        use_inh = getattr(self.cfg, "inherit_bounds", True)
+        bary_memo: dict[int, np.ndarray] = {}
+
+        def _bary(n: int) -> np.ndarray:
+            if n not in bary_memo:
+                bary_memo[n] = geometry.barycentric_matrix(
+                    self.tree.vertices[n])
+            return bary_memo[n]
+
+        # Exact per-delta facts established THIS step (Farkas +inf
+        # exclusions, exact simplex minima) -- inherited by children when
+        # the node splits.
+        fresh: dict[int, dict[int, float]] = collections.defaultdict(dict)
         for n in nodes:
             sd = self._vertex_data(n)
             sds[n] = sd
@@ -236,33 +264,93 @@ class FrontierEngine:
             # hybrid feasible set is a union over commutations, not
             # convex): require positive phase-1 evidence that EVERY
             # commutation is infeasible on the whole simplex; otherwise
-            # split to hunt for the interior feasible pocket.
+            # split to hunt for the interior feasible pocket.  Commutations
+            # already Farkas-certified infeasible on an ANCESTOR simplex
+            # (child subset of ancestor) are exact and skipped -- note this
+            # decision is STRICTLY more accurate than re-solving (a child
+            # phase-1 that stalls would demote an exactly-known infeasible
+            # to 'split'), so an inheritance-free build can in principle
+            # split where this one closes an infeasible leaf.
             nd = self.oracle.can.n_delta
-            reqs = [(n, d) for n in infeas_candidates for d in range(nd)]
-            Ms = np.stack([geometry.barycentric_matrix(self.tree.vertices[n])
-                           for n, _ in reqs])
-            ds = np.array([d for _, d in reqs], dtype=np.int64)
-            _t, _feas, infeas_cert = self._oracle_call(
-                "simplex_feasibility", Ms, ds)
-            empty_on_R = collections.defaultdict(lambda: True)
-            for (n, _), ok in zip(reqs, infeas_cert):
-                empty_on_R[n] &= bool(ok)
+            empty_on_R = {n: True for n in infeas_candidates}
+            reqs = []
+            for n in infeas_candidates:
+                inh = self._inherit.get(n, {}) if use_inh else {}
+                for d in range(nd):
+                    if inh.get(d) == np.inf:
+                        self.n_inherited_skips += 1
+                    else:
+                        reqs.append((n, d))
+            if reqs:
+                Ms = np.stack([_bary(n) for n, _ in reqs])
+                ds = np.array([d for _, d in reqs], dtype=np.int64)
+                _t, _feas, infeas_cert = self._oracle_call(
+                    "simplex_feasibility", Ms, ds)
+                for (n, d), ok in zip(reqs, infeas_cert):
+                    empty_on_R[n] &= bool(ok)
+                    if ok:
+                        fresh[n][d] = np.inf
             for n in infeas_candidates:
                 if not empty_on_R[n]:
                     results[n] = certify.CertificateResult(status="split")
                 # else keep 'infeasible': certified empty on R
 
         if stage2:
-            Ms = np.stack([geometry.barycentric_matrix(self.tree.vertices[n])
-                           for n, _ in stage2])
-            ds = np.array([d for _, d in stage2], dtype=np.int64)
-            Vmin, _feas = self._oracle_call("solve_simplex_min", Ms, ds)
-            per_node: dict[int, dict[int, float]] = collections.defaultdict(dict)
-            for (n, d), vm in zip(stage2, Vmin):
-                per_node[n][d] = float(vm)
-            for n, vm in per_node.items():
-                results[n] = certify.certify_suboptimal_stage2(
-                    sds[n], results[n], vm, self.cfg.eps_a, self.cfg.eps_r)
+            # Round A: solve only (node, delta') pairs with NO inherited
+            # bound.  +inf entries are exact ancestor Farkas exclusions;
+            # finite entries are ancestor simplex minima -- valid lower
+            # bounds on any child, tried for free first.
+            solve_list: list[tuple[int, int]] = []
+            vm_map: dict[int, dict[int, float]] = collections.defaultdict(dict)
+            loose: dict[int, list[int]] = collections.defaultdict(list)
+            for n, d in stage2:
+                inh = self._inherit.get(n, {}) if use_inh else {}
+                b = inh.get(d)
+                if b is None or b == -np.inf:
+                    solve_list.append((n, d))
+                elif b == np.inf:
+                    vm_map[n][d] = np.inf
+                    self.n_inherited_skips += 1
+                else:
+                    vm_map[n][d] = b
+                    loose[n].append(d)
+                    self.n_inherited_skips += 1
+            if solve_list:
+                Ms = np.stack([_bary(n) for n, _ in solve_list])
+                ds = np.array([d for _, d in solve_list], dtype=np.int64)
+                Vmin, _feas = self._oracle_call("solve_simplex_min", Ms, ds)
+                for (n, d), vm in zip(solve_list, Vmin):
+                    vm_map[n][d] = float(vm)
+                    fresh[n][d] = float(vm)
+            # Certify with what we have.  A PASS with loose bounds is sound
+            # (looser lower bound => larger gap; exact build would also
+            # pass -- though possibly selecting a different certifying
+            # candidate delta, so leaf delta_idx is NOT guaranteed
+            # bit-identical, only the certify/split decision).  A FAIL that
+            # used loose bounds is inconclusive: round B re-solves exactly
+            # so the split/certify decision matches an inheritance-free
+            # build (region/structure parity).
+            roundB: list[tuple[int, int]] = []
+            for n in sorted(vm_map):
+                res2 = certify.certify_suboptimal_stage2(
+                    sds[n], results[n], vm_map[n], self.cfg.eps_a,
+                    self.cfg.eps_r)
+                if res2.status == "certified" or not loose[n]:
+                    results[n] = res2
+                else:
+                    roundB.extend((n, d) for d in loose[n])
+            if roundB:
+                Ms = np.stack([_bary(n) for n, _ in roundB])
+                ds = np.array([d for _, d in roundB], dtype=np.int64)
+                Vmin, _feas = self._oracle_call("solve_simplex_min", Ms, ds)
+                for (n, d), vm in zip(roundB, Vmin):
+                    vm_map[n][d] = float(vm)
+                    fresh[n][d] = float(vm)
+                    self.n_inherited_skips -= 1  # loose bound did not stick
+                for n in sorted({nn for nn, _ in roundB}):
+                    results[n] = certify.certify_suboptimal_stage2(
+                        sds[n], results[n], vm_map[n], self.cfg.eps_a,
+                        self.cfg.eps_r)
 
         n_leaves = n_splits = 0
         for n in nodes:
@@ -288,6 +376,7 @@ class FrontierEngine:
                             delta_idx=d, vertex_inputs=sd.u0[:, d, :],
                             vertex_costs=sd.V[:, d],
                             vertex_z=sd.z[:, d, :]))
+                    self._inherit.pop(n, None)
                     self._release(n)
                     continue
                 left, right, i, j, _ = geometry.bisect(self.tree.vertices[n])
@@ -299,7 +388,18 @@ class FrontierEngine:
                 # evict + re-solve them).
                 self._retain(li)
                 self._retain(ri)
+                if use_inh:
+                    # Children inherit ancestor facts, overridden by this
+                    # step's exact results (tighter: computed on n's own R).
+                    # -inf (stalled solve, no usable bound) is never stored.
+                    child_inh = {**self._inherit.get(n, {}),
+                                 **{d: v for d, v in fresh[n].items()
+                                    if v != -np.inf}}
+                    if child_inh:
+                        self._inherit[li] = dict(child_inh)
+                        self._inherit[ri] = child_inh
                 n_splits += 1
+            self._inherit.pop(n, None)
             self._release(n)
 
         self.steps += 1
@@ -361,6 +461,12 @@ class FrontierEngine:
             "max_depth": self.tree.max_depth(),
             "steps": self.steps,
             "oracle_solves": self.oracle.n_solves,
+            # Solve mix: stage-2 joint simplex QPs dominated round-2's
+            # builds (82% of solves); bound inheritance exists to flip
+            # that, and `inherited_skips` counts the solves it avoided.
+            "point_solves": self.oracle.n_point_solves,
+            "simplex_solves": self.oracle.n_simplex_solves,
+            "inherited_skips": self.n_inherited_skips,
             "uncertified": self.n_uncertified,
             # Non-empty frontier here means the run hit max_steps: the
             # remaining simplices are UNCOVERED holes, not a complete
@@ -399,6 +505,15 @@ class FrontierEngine:
                 "n_uncertified": self.n_uncertified,
                 "n_unique_solves": self.n_unique_solves,
                 "n_solves": self.oracle.n_solves,
+                "n_point_solves": self.oracle.n_point_solves,
+                "n_simplex_solves": self.oracle.n_simplex_solves,
+                # Inherited per-delta bounds are part of frontier state:
+                # dropping them on resume would be sound (they are an
+                # optimization) but would break resumed-equals-straight
+                # solve-count parity.
+                "inherit": {n: self._inherit[n] for n in self.frontier
+                            if n in self._inherit},
+                "n_inherited_skips": self.n_inherited_skips,
                 "cfg": self.cfg,
             }, f, protocol=pickle.HIGHEST_PROTOCOL)
 
@@ -430,9 +545,13 @@ class FrontierEngine:
         eng.n_uncertified = snap["n_uncertified"]
         eng.n_unique_solves = snap.get("n_unique_solves", 0)
         eng.n_device_failures = 0
+        eng._inherit = dict(snap.get("inherit", {}))
+        eng.n_inherited_skips = snap.get("n_inherited_skips", 0)
         eng._fb_oracle = None
         eng._oracle_s = 0.0
         oracle.n_solves = snap.get("n_solves", 0)
+        oracle.n_point_solves = snap.get("n_point_solves", 0)
+        oracle.n_simplex_solves = snap.get("n_simplex_solves", 0)
         # Rebuild the open-simplex refcounts from the restored frontier and
         # drop cache rows no open simplex references (the snapshot may
         # predate their eviction).

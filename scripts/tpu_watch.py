@@ -1,0 +1,151 @@
+"""TPU-availability watcher: poll the chip; capture benchmarks when it answers.
+
+Round-2 verdict item 1: both driver captures and the judge probe found the
+TPU tunnel dead, while builder sessions saw it alive -- so the capture must
+be event-driven, not one-shot.  This script loops forever:
+
+  1. probe the default jax backend in a subprocess (a dead tunnel hangs
+     inside TPU init, so the probe gets a hard timeout);
+  2. if the answer is a real accelerator, run every capture script whose
+     artifact is still missing-or-non-TPU, in priority order (north star ->
+     bench -> per-config table -> online crossover), each under its own
+     subprocess timeout so a chip dying mid-capture only loses that one;
+  3. `git commit` any artifacts produced (retrying around index locks held
+     by a concurrent builder session);
+  4. exit once every artifact records a TPU platform, else sleep and re-poll.
+
+Run under tmux so it outlives any single builder command:
+    tmux new-session -d -s tpuwatch 'python scripts/tpu_watch.py'
+Env: WATCH_INTERVAL_S (default 600), WATCH_PROBE_TIMEOUT (default 150).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ART = os.path.join(REPO, "artifacts")
+
+# (artifact, script, env, timeout_s, platform_key)
+CAPTURES = [
+    ("north_star.json", "scripts/north_star.py",
+     {"NS_TIME_BUDGET": "900"}, 7200, ("flagship", "platform")),
+    ("bench_tpu.json", "bench.py", {"BENCH_OUT": "artifacts/bench_tpu.json"},
+     1800, ("platform",)),
+    ("configs.json", "scripts/bench_configs.py",
+     {"CONFIGS_TIME_BUDGET": "300"}, 5400, ("platform",)),
+    ("online_crossover.json", "scripts/online_crossover.py", {}, 5400,
+     ("platform",)),
+]
+
+
+def log(msg: str) -> None:
+    print(f"[{time.strftime('%H:%M:%S')}] {msg}", flush=True)
+
+
+def probe(timeout: float) -> str | None:
+    """Default-backend platform name, or None if unreachable/hung."""
+    code = ("import jax, json; "
+            "print(json.dumps({'p': jax.default_backend(), "
+            "'n': jax.device_count()}))")
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    try:
+        out = subprocess.run([sys.executable, "-c", code], cwd=REPO,
+                             capture_output=True, text=True, timeout=timeout,
+                             env=env)
+        if out.returncode != 0:
+            return None
+        return json.loads(out.stdout.strip().splitlines()[-1])["p"]
+    except (subprocess.TimeoutExpired, Exception):
+        return None
+
+
+def artifact_platform(name: str, keys: tuple) -> str | None:
+    path = os.path.join(ART, name)
+    try:
+        with open(path) as f:
+            d = json.load(f)
+        for k in keys:
+            d = d[k]
+        return d
+    except Exception:
+        return None
+
+
+def needed() -> list:
+    return [c for c in CAPTURES
+            if artifact_platform(c[0], c[4]) not in ("tpu", "gpu")]
+
+
+def run_capture(name: str, script: str, env_extra: dict, timeout: float) -> bool:
+    log(f"capture {name} via {script} (timeout {timeout}s)")
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env.update(env_extra)
+    logpath = os.path.join(ART, name.replace(".json", ".log"))
+    os.makedirs(ART, exist_ok=True)
+    try:
+        with open(logpath, "w") as lf:
+            subprocess.run([sys.executable, script], cwd=REPO, env=env,
+                           stdout=lf, stderr=subprocess.STDOUT,
+                           timeout=timeout)
+    except subprocess.TimeoutExpired:
+        log(f"  {name}: TIMED OUT after {timeout}s")
+    plat = artifact_platform(name, dict(zip([c[0] for c in CAPTURES],
+                                            [c[4] for c in CAPTURES]))[name])
+    log(f"  {name}: platform={plat}")
+    return plat in ("tpu", "gpu")
+
+
+def commit() -> None:
+    for attempt in range(10):
+        try:
+            subprocess.run(["git", "add", "artifacts"], cwd=REPO, check=True,
+                           capture_output=True)
+            st = subprocess.run(
+                ["git", "diff", "--cached", "--quiet", "--", "artifacts"],
+                cwd=REPO)
+            if st.returncode == 0:
+                return
+            # Pathspec-limited commit: a concurrent builder session may
+            # have unrelated files staged; sweeping them into this commit
+            # would lose them from the builder's own commit.
+            subprocess.run(
+                ["git", "commit", "-m",
+                 "Capture TPU benchmark artifacts (watcher)",
+                 "--", "artifacts"],
+                cwd=REPO, check=True, capture_output=True)
+            log("committed artifacts")
+            return
+        except subprocess.CalledProcessError as e:
+            log(f"git attempt {attempt}: {e.stderr.decode()[:200]}")
+            time.sleep(30)
+
+
+def main() -> None:
+    interval = float(os.environ.get("WATCH_INTERVAL_S", "600"))
+    probe_t = float(os.environ.get("WATCH_PROBE_TIMEOUT", "150"))
+    while True:
+        todo = needed()
+        if not todo:
+            log("all artifacts captured on accelerator; watcher done")
+            return
+        plat = probe(probe_t)
+        log(f"probe -> {plat}; {len(todo)} capture(s) pending")
+        if plat not in (None, "cpu"):
+            for name, script, env_extra, timeout, _keys in todo:
+                run_capture(name, script, env_extra, timeout)
+                commit()
+                if probe(probe_t) in (None, "cpu"):
+                    log("chip lost mid-suite; back to polling")
+                    break
+        time.sleep(interval)
+
+
+if __name__ == "__main__":
+    main()

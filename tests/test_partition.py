@@ -172,6 +172,31 @@ def test_time_budget_truncates_honestly():
     assert res.stats["frontier_left"] > 0
 
 
+def test_inherited_bounds_parity_and_savings():
+    """Round-2 verdict item 2: inheriting per-delta Farkas exclusions and
+    simplex-min lower bounds down the tree must (a) leave the produced tree
+    IDENTICAL to an inheritance-free build (the round-B exact re-solve
+    guarantees decision parity) and (b) actually cut stage-2 joint-QP
+    volume on a hybrid problem."""
+    prob = make("inverted_pendulum", N=3)
+    stats = {}
+    for inherit in (False, True):
+        cfg = PartitionConfig(problem="inverted_pendulum", eps_a=0.5,
+                              backend="cpu", batch_simplices=64,
+                              max_depth=14, inherit_bounds=inherit)
+        res = build_partition(prob, cfg, Oracle(prob, backend="cpu"))
+        stats[inherit] = res.stats
+    assert stats[True]["regions"] == stats[False]["regions"]
+    assert stats[True]["tree_nodes"] == stats[False]["tree_nodes"]
+    assert stats[True]["max_depth"] == stats[False]["max_depth"]
+    assert stats[True]["uncertified"] == stats[False]["uncertified"]
+    # The point of the feature: measurably fewer joint simplex QPs.
+    assert stats[True]["inherited_skips"] > 0
+    assert stats[True]["simplex_solves"] < stats[False]["simplex_solves"]
+    # Point-solve volume is unchanged (vertex cache logic untouched).
+    assert stats[True]["point_solves"] == stats[False]["point_solves"]
+
+
 def test_serial_vs_batched_region_parity():
     """North-star requirement: identical region count between the serial
     oracle baseline and the batched backend (BASELINE.json)."""

@@ -106,7 +106,12 @@ def choose_backend(result: dict | None = None) -> str:
 
     import jax
 
-    if chosen == "cpu":
+    if forced:
+        # Pin WHATEVER was forced, not just cpu: on a multi-backend host,
+        # skipping the probe without pinning would silently run on the
+        # default backend instead of the forced one.
+        jax.config.update("jax_platforms", forced)
+    elif chosen == "cpu":
         # Must run before the first device query; the env var JAX_PLATFORMS
         # alone is overridden by the axon plugin (verify SKILL.md gotcha).
         jax.config.update("jax_platforms", "cpu")
@@ -122,6 +127,23 @@ def choose_backend(result: dict | None = None) -> str:
     return platform
 
 
+def retry_transient(fn, attempts: int = 3, wait_s: float = 20.0,
+                    what: str = ""):
+    """Run fn(), retrying transient device/tunnel errors (the axon remote-
+    compile endpoint drops connections under load -- observed r3:
+    'remote_compile: read body: response body closed').  Programming
+    errors (TypeError/ValueError) propagate immediately."""
+    for k in range(attempts):
+        try:
+            return fn()
+        except (RuntimeError, OSError) as e:
+            if k == attempts - 1:
+                raise
+            log(f"transient device error in {what}: {e!r}; "
+                f"retry {k + 1}/{attempts - 1} in {wait_s:.0f}s")
+            time.sleep(wait_s)
+
+
 def warm_oracle(oracle, problem, stop_after: float | None = None) -> None:
     """Compile every power-of-two vertex-batch bucket up front so compile
     time stays out of the timed region.  `stop_after`: optional epoch
@@ -134,8 +156,10 @@ def warm_oracle(oracle, problem, stop_after: float | None = None) -> None:
             log(f"warmup stopped early at bucket {b} (deadline guard)")
             break
         log(f"warmup: bucket {b}")
-        oracle.solve_vertices(rng.uniform(problem.theta_lb, problem.theta_ub,
-                                          size=(b, problem.n_theta)))
+        pts = rng.uniform(problem.theta_lb, problem.theta_ub,
+                          size=(b, problem.n_theta))
+        retry_transient(lambda: oracle.solve_vertices(pts),
+                        what=f"warmup bucket {b}")
         b *= 2
 
 
@@ -206,8 +230,15 @@ def run(result: dict) -> None:
     result.update(value=round(stats["regions_per_s"], 2),
                   regions=stats["regions"],
                   oracle_solves=stats["oracle_solves"],
+                  point_solves=stats["point_solves"],
+                  simplex_solves=stats["simplex_solves"],
+                  inherited_skips=stats["inherited_skips"],
                   wall_s=round(stats["wall_s"], 2),
-                  truncated=stats["truncated"])
+                  truncated=stats["truncated"],
+                  # Batches that fell back to the CPU twin mid-build (a
+                  # flaky tunnel makes a 'tpu' number partially CPU-run;
+                  # nonzero here flags that honestly).
+                  device_failures=stats["device_failures"])
 
     # -- serial-oracle baseline estimate -----------------------------------
     # Point QPs and joint simplex QPs are structurally different sizes:
@@ -293,6 +324,11 @@ def main() -> int:
     finally:
         # The one guaranteed JSON line, success or not.
         print(json.dumps(result), flush=True)
+        out_path = os.environ.get("BENCH_OUT")
+        if out_path:  # artifact copy for the TPU watcher / judge
+            os.makedirs(os.path.dirname(out_path) or ".", exist_ok=True)
+            with open(out_path, "w") as f:
+                json.dump(result, f, indent=2)
     return 0 if result.get("value") is not None else 1
 
 

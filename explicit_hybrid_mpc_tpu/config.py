@@ -23,6 +23,11 @@ class PartitionConfig:
 
     # Which benchmark problem (problems/registry.py).
     problem: str = "double_integrator"
+    # Problem constructor overrides as a sorted (key, value) pair tuple
+    # (tuple: hashable-ish + frozen-friendly).  Recorded so checkpoints pin
+    # the EXACT problem: resuming with different constructor args changes
+    # matrix shapes and corrupts the solve cache (found by e2e verify r3).
+    problem_args: tuple = ()
     # Absolute suboptimality tolerance (eps_a <= 0 disables the check).
     eps_a: float = 1e-2
     # Relative suboptimality tolerance (eps_r <= 0 disables the check).

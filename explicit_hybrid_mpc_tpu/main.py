@@ -91,7 +91,20 @@ def main(argv: list[str] | None = None) -> int:
         print("\n".join(names()))
         return 0
 
-    if args.backend in ("cpu", "serial"):
+    snapshot = None
+    if args.resume:
+        # Loaded here, before the platform-pin decision: on --resume the
+        # EFFECTIVE backend is the snapshot's, not args.backend, and a
+        # resumed cpu/serial build must still get the pin below (else a
+        # dead TPU tunnel hangs a pure-CPU run).  Unpickling touches no
+        # device; the dict is reused by the resume block further down.
+        import pickle
+
+        with open(args.resume, "rb") as f:
+            snapshot = pickle.load(f)
+
+    effective_backend = snapshot["cfg"].backend if snapshot else args.backend
+    if effective_backend in ("cpu", "serial"):
         # Pin the platform BEFORE the first device query: with the TPU
         # plugin registered, jax.devices("cpu") still initializes every
         # backend, and a dead TPU tunnel then hangs a pure-CPU run.
@@ -106,13 +119,14 @@ def main(argv: list[str] | None = None) -> int:
     from explicit_hybrid_mpc_tpu.partition.frontier import FrontierEngine
     from explicit_hybrid_mpc_tpu.utils.logging import RunLog
 
-    problem = make(args.example, **_parse_problem_args(args.problem_arg))
+    problem_args = _parse_problem_args(args.problem_arg)
     prefix = args.output
     os.makedirs(os.path.dirname(prefix) or ".", exist_ok=True)
     eps_a = args.eps_a if args.eps_a is not None else (
         1e-2 if args.eps_r is None else 0.0)
     cfg = PartitionConfig(
-        problem=args.example, eps_a=eps_a,
+        problem=args.example,
+        problem_args=tuple(sorted(problem_args.items())), eps_a=eps_a,
         eps_r=args.eps_r if args.eps_r is not None else 0.0,
         algorithm=args.algorithm, backend=args.backend,
         batch_simplices=args.batch, max_depth=args.max_depth,
@@ -123,33 +137,45 @@ def main(argv: list[str] | None = None) -> int:
         log_path=f"{prefix}.log.jsonl", precision=args.precision,
         profile_path=args.profile, profile_steps=args.profile_steps)
 
-    snapshot = None
-    if args.resume:
+    if snapshot is not None:
         # SOLVER flags (precision/backend/eps/batch...) come from the
         # snapshot: silently mixing CLI values into a half-built partition
-        # would change solver behaviour mid-build with no record.  OUTPUT
-        # flags (log/checkpoint/profile paths) stay with THIS run's -o
-        # prefix -- a resumed build must not append to the old run's log
-        # or overwrite its checkpoint.  Loaded once; FrontierEngine.resume
-        # reuses the dict (the snapshot holds the whole tree + cache).
+        # would change solver behaviour mid-build with no record.  RUN-
+        # BUDGET and OUTPUT flags (max_steps; log/checkpoint/profile paths)
+        # stay with THIS run: the usual reason to resume is precisely to
+        # EXTEND a budget-truncated build, and a resumed build must not
+        # append to the old run's log or overwrite its checkpoint.
+        # FrontierEngine.resume reuses the dict (the snapshot holds the
+        # whole tree + cache).
         import dataclasses
-        import pickle
 
-        with open(args.resume, "rb") as f:
-            snapshot = pickle.load(f)
         snap_cfg = snapshot["cfg"]
-        for fld in ("eps_a", "eps_r", "algorithm", "backend", "precision",
-                    "batch_simplices", "max_depth", "max_steps"):
-            cli_v, snap_v = getattr(cfg, fld), getattr(snap_cfg, fld)
+        if not hasattr(snap_cfg, "problem_args"):
+            # Snapshot predates the problem_args field: trust this run's
+            # --problem-arg values (the old behaviour), recorded going
+            # forward.  object.__setattr__ is the frozen-dataclass patch.
+            object.__setattr__(snap_cfg, "problem_args",
+                               cfg.problem_args)
+        for fld in ("problem", "problem_args", "eps_a", "eps_r",
+                    "algorithm", "backend", "precision",
+                    "batch_simplices", "max_depth"):
+            cli_v = getattr(cfg, fld)
+            # default: pre-problem_args snapshots lack the field
+            snap_v = getattr(snap_cfg, fld, cli_v)
             if cli_v != snap_v:
                 print(f"resume: using snapshot {fld}={snap_v!r} "
                       f"(CLI value {cli_v!r} ignored)", file=sys.stderr)
         cfg = dataclasses.replace(
             snap_cfg, log_path=cfg.log_path,
+            max_steps=cfg.max_steps,
             checkpoint_every=cfg.checkpoint_every,
             checkpoint_path=cfg.checkpoint_path,
             profile_path=cfg.profile_path,
             profile_steps=cfg.profile_steps)
+
+    # Built from the FINAL cfg: on resume that is the snapshot's problem +
+    # constructor args, so matrix shapes always match the restored cache.
+    problem = make(cfg.problem, **dict(getattr(cfg, "problem_args", ())))
 
     mesh = None
     if args.mesh:

@@ -156,7 +156,13 @@ class FrontierEngine:
         t0 = time.perf_counter()
         try:
             return getattr(self.oracle, method)(*args)
-        except Exception as e:  # noqa: BLE001 -- any device error retries
+        except (RuntimeError, OSError) as e:
+            # XlaRuntimeError (dead tunnel, device OOM, interconnect
+            # faults) subclasses RuntimeError; socket/tunnel drops raise
+            # OSError.  Deterministic programming errors (TypeError/
+            # ValueError/shape bugs) propagate instead of being retried on
+            # the fallback, where they would resurface as a second failure
+            # mislabeled 'device_failure' (round-2 advisor item).
             self.n_device_failures += 1
             self.log.emit(device_failure=repr(e)[:500], query=method,
                           retry_backend="cpu")

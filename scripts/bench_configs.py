@@ -43,11 +43,17 @@ CONFIGS = [
 def main() -> int:
     out_path = os.environ.get("CONFIGS_OUT", "artifacts/configs.json")
     precision = os.environ.get("CFG_PRECISION", "mixed")
-    budget = float(os.environ.get("CFG_TIME_BUDGET", "600"))
+    budget = float(os.environ.get("CFG_TIME_BUDGET")
+                   or os.environ.get("CONFIGS_TIME_BUDGET")  # tpu_watch name
+                   or "600")
     only = os.environ.get("CFG_ONLY")
     only_names = set(only.split(",")) if only else None
 
-    platform = choose_backend()
+    result = {"captured_at": time.strftime("%Y-%m-%d %H:%M:%S"),
+              "precision": precision,
+              "per_config_budget_s": budget, "rows": []}
+    # Probe flags land in the artifact (round-2 advisor item).
+    platform = choose_backend(result)
     on_acc = platform != "cpu"
 
     from explicit_hybrid_mpc_tpu.config import PartitionConfig
@@ -55,10 +61,6 @@ def main() -> int:
     from explicit_hybrid_mpc_tpu.partition.frontier import build_partition
     from explicit_hybrid_mpc_tpu.post import analysis
     from explicit_hybrid_mpc_tpu.problems.registry import make
-
-    result = {"captured_at": time.strftime("%Y-%m-%d %H:%M:%S"),
-              "platform": platform, "precision": precision,
-              "per_config_budget_s": budget, "rows": []}
     for label, name, kwargs, eps_a in CONFIGS:
         if only_names and name not in only_names:
             continue

@@ -17,8 +17,9 @@ these must be committed artifacts, not prose):
 
 Backend selection reuses bench.py's subprocess probe (a dead TPU tunnel
 degrades to an honest CPU capture, never a hang).  Env knobs:
-NORTH_STAR_OUT, NS_TIME_BUDGET, NS_PARITY_EPS, NS_PRECISION, plus
-bench.py's BENCH_PLATFORM / BENCH_PROBE_TIMEOUT.
+NORTH_STAR_OUT, NS_TIME_BUDGET, NS_PARITY_EPS, NS_PRECISION, NS_PROBLEM /
+NS_POINTS_CAP (smoke-test shrinks), plus bench.py's BENCH_PLATFORM /
+BENCH_PROBE_TIMEOUT.
 """
 
 from __future__ import annotations
@@ -39,6 +40,7 @@ def run(result: dict) -> None:
     precision = os.environ.get("NS_PRECISION", "mixed")
     parity_eps = float(os.environ.get("NS_PARITY_EPS", "0.1"))
     budget = float(os.environ.get("NS_TIME_BUDGET", "900"))
+    problem_name = os.environ.get("NS_PROBLEM", "inverted_pendulum")
     platform = choose_backend(result)
 
     from explicit_hybrid_mpc_tpu.config import PartitionConfig
@@ -46,15 +48,16 @@ def run(result: dict) -> None:
     from explicit_hybrid_mpc_tpu.partition.frontier import build_partition
     from explicit_hybrid_mpc_tpu.problems.registry import make
 
-    problem = make("inverted_pendulum")
+    problem = make(problem_name)
     on_acc = platform != "cpu"
-    points_cap = 2048 if on_acc else 256
+    points_cap = int(os.environ.get("NS_POINTS_CAP",
+                                    "2048" if on_acc else "256"))
 
     # -- 1. flagship build -------------------------------------------------
     oracle = Oracle(problem, backend="device" if on_acc else "cpu",
                     precision=precision, points_cap=points_cap)
     warm_oracle(oracle, problem)
-    warm_cfg = PartitionConfig(problem="inverted_pendulum", eps_a=1.0,
+    warm_cfg = PartitionConfig(problem=problem_name, eps_a=1.0,
                                backend="device", batch_simplices=512,
                                max_steps=50, time_budget_s=120.0,
                                precision=precision)
@@ -62,7 +65,7 @@ def run(result: dict) -> None:
     oracle.n_solves = oracle.n_point_solves = oracle.n_simplex_solves = 0
 
     log(f"flagship build (eps_a=1e-2, budget {budget:.0f}s)...")
-    cfg = PartitionConfig(problem="inverted_pendulum", eps_a=1e-2,
+    cfg = PartitionConfig(problem=problem_name, eps_a=1e-2,
                           backend="device", batch_simplices=512,
                           max_steps=20_000, precision=precision,
                           time_budget_s=budget)
@@ -71,7 +74,7 @@ def run(result: dict) -> None:
     stats = res.stats
     log(f"flagship: {stats}")
     result["flagship"] = {
-        "problem": "inverted_pendulum", "eps_a": 1e-2,
+        "problem": problem_name, "eps_a": 1e-2,
         "precision": precision, "platform": platform,
         "regions": stats["regions"],
         "regions_per_s": round(stats["regions_per_s"], 2),
@@ -80,6 +83,10 @@ def run(result: dict) -> None:
         "uncertified": stats["uncertified"],
         "max_depth": stats["max_depth"],
         "oracle_solves": stats["oracle_solves"],
+        "point_solves": stats["point_solves"],
+        "simplex_solves": stats["simplex_solves"],
+        "inherited_skips": stats["inherited_skips"],
+        "device_failures": stats["device_failures"],
         "cache_peak_mb": stats["cache_peak_mb"],
     }
 
@@ -101,7 +108,7 @@ def run(result: dict) -> None:
     log(f"parity builds (eps_a={parity_eps}): device vs serial...")
     counts = {}
     for backend in (("device" if on_acc else "cpu"), "serial"):
-        pcfg = PartitionConfig(problem="inverted_pendulum",
+        pcfg = PartitionConfig(problem=problem_name,
                                eps_a=parity_eps, backend=backend,
                                batch_simplices=256, precision=precision,
                                time_budget_s=1800.0)

@@ -48,8 +48,14 @@ def main() -> int:
         "CROSS_EPS", "0.5,0.2,0.1,0.05,0.02,0.01").split(",")]
     B = int(os.environ.get("CROSS_BATCH", "4096"))
 
-    platform = choose_backend()
-    on_tpu = platform == "tpu"
+    result = {"captured_at": time.strftime("%Y-%m-%d %H:%M:%S"),
+              "batch": B, "rows": []}
+    # Shared conventions with bench.py / north_star.py (round-2 advisor
+    # item): probe flags land in the artifact, and the oracle runs on any
+    # non-cpu accelerator, not just tpu.
+    platform = choose_backend(result)
+    on_acc = platform != "cpu"
+    on_tpu = platform == "tpu"  # Mosaic-compiled Pallas timing: TPU only
 
     import jax.numpy as jnp
 
@@ -61,13 +67,11 @@ def main() -> int:
     from explicit_hybrid_mpc_tpu.problems.registry import make
 
     prob = make("double_integrator")
-    oracle = Oracle(prob, backend="device" if on_tpu else "cpu",
-                    precision="mixed", points_cap=2048 if on_tpu else 256)
+    oracle = Oracle(prob, backend="device" if on_acc else "cpu",
+                    precision="mixed", points_cap=2048 if on_acc else 256)
     rngq = np.random.default_rng(3)
     qs = jnp.asarray(rngq.uniform(prob.theta_lb, prob.theta_ub,
                                   size=(B, prob.n_theta)))
-    result = {"captured_at": time.strftime("%Y-%m-%d %H:%M:%S"),
-              "platform": platform, "batch": B, "rows": []}
     for eps in eps_list:
         cfg = PartitionConfig(problem="double_integrator", eps_a=eps,
                               backend="device", batch_simplices=512,

@@ -1,0 +1,77 @@
+"""Smoke tests for the three benchmark capture scripts (round-2 verdict
+item 5: the scripts that carry the round's TPU evidence must be proven
+runnable on CPU with tiny budgets BEFORE a chip-up window, the way
+tests/test_bench.py proved bench.py after round 1's crash).
+
+Each test runs the script in a subprocess with BENCH_PLATFORM=cpu and
+shrunken knobs, then asserts the artifact JSON exists, parses, and has the
+fields the judge/BASELINE.md read."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(script: str, env_extra: dict, out_path: str,
+         timeout: int = 420) -> dict:
+    env = dict(os.environ, BENCH_PLATFORM="cpu", **env_extra)
+    proc = subprocess.run([sys.executable, script], capture_output=True,
+                          text=True, timeout=timeout, cwd=REPO, env=env)
+    assert os.path.exists(out_path), (
+        f"{script} wrote no artifact; stderr tail: {proc.stderr[-800:]}")
+    with open(out_path) as f:
+        data = json.load(f)
+    assert "error" not in data, f"{script} errored: {data['error']}"
+    return data
+
+
+def test_north_star_smoke(tmp_path):
+    out = str(tmp_path / "north_star.json")
+    data = _run("scripts/north_star.py", {
+        "NORTH_STAR_OUT": out,
+        "NS_PROBLEM": "double_integrator",
+        "NS_TIME_BUDGET": "45",
+        "NS_PARITY_EPS": "0.5",
+        "NS_POINTS_CAP": "64",
+    }, out)
+    fl = data["flagship"]
+    assert fl["platform"] == "cpu"
+    assert fl["regions"] > 0 and fl["regions_per_s"] > 0
+    assert fl["vs_serial_estimate"] > 0
+    par = data["parity"]
+    assert par["parity_ok"] is True, f"parity mismatch: {par}"
+    assert par["batched"]["regions"] == par["serial"]["regions"]
+
+
+def test_bench_configs_smoke(tmp_path):
+    out = str(tmp_path / "configs.json")
+    data = _run("scripts/bench_configs.py", {
+        "CONFIGS_OUT": out,
+        "CFG_ONLY": "double_integrator",
+        "CFG_TIME_BUDGET": "40",
+    }, out)
+    assert data["platform"] == "cpu"
+    rows = data["rows"]
+    assert len(rows) == 1 and rows[0]["problem"] == "double_integrator"
+    assert "error" not in rows[0], rows[0]
+    assert rows[0]["regions"] > 0
+    assert 0.0 < rows[0]["volume_certified_frac"] <= 1.0
+
+
+def test_online_crossover_smoke(tmp_path):
+    out = str(tmp_path / "crossover.json")
+    data = _run("scripts/online_crossover.py", {
+        "CROSS_OUT": out,
+        "CROSS_EPS": "0.5,0.3",
+        "CROSS_BATCH": "256",
+    }, out)
+    assert data["platform"] == "cpu"
+    rows = data["rows"]
+    assert len(rows) == 2
+    for row in rows:
+        assert row["leaves"] > 0
+        assert row["jax_us"] > 0 and row["descent_us"] > 0
+        assert "pallas_us" not in row  # Mosaic timing is TPU-only

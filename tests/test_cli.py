@@ -90,6 +90,29 @@ def test_resume_uses_snapshot_cfg(tmp_path, capsys):
     assert old_log_size > 0  # written only by the first run
 
 
+def test_resume_extends_truncated_build(tmp_path):
+    """max_steps is a RUN-BUDGET flag: resuming a max_steps-truncated
+    build with a larger --max-steps must finish it, and the problem
+    constructor args must come from the snapshot (passing different
+    --problem-arg values used to corrupt the restored solve cache --
+    found by e2e verify, round 3)."""
+    prefix = str(tmp_path / "tr")
+    rc = main(["-e", "double_integrator", "-a", "0.2", "--backend", "cpu",
+               "--batch", "64", "-o", prefix, "--checkpoint-every", "2",
+               "--max-steps", "6",
+               "--problem-arg", "N=3", "--problem-arg", "theta_box=1.5"])
+    assert rc == 0
+    stats = json.load(open(f"{prefix}.stats.json"))
+    assert stats["truncated"]
+    prefix2 = str(tmp_path / "tr2")
+    # No --problem-arg, no --backend: both must come from the snapshot.
+    rc = main(["-e", "double_integrator", "--resume", f"{prefix}.ckpt.pkl",
+               "-o", prefix2, "--max-steps", "500"])
+    assert rc == 0
+    stats2 = json.load(open(f"{prefix2}.stats.json"))
+    assert not stats2["truncated"] and stats2["regions"] > 0
+
+
 def test_bad_example():
     with pytest.raises(KeyError):
         main(["-e", "not_a_problem", "-a", "0.1", "--backend", "cpu"])

@@ -44,6 +44,10 @@ class QPSolution(NamedTuple):
     converged: jax.Array  # (...,) bool: KKT satisfied to tolerance
     feasible: jax.Array   # (...,) bool: primal residual small (a converged
     #                       point exists; infeasible QPs keep rp large)
+    f32_ok: jax.Array     # (...,) bool: mixed schedule only -- the f32
+    #                       warm start passed the f64 merit gate (False
+    #                       when n_f32 == 0; the observable behind the
+    #                       f32_accept_rate benchmark field)
 
 
 _TINY = 1e-12
@@ -149,6 +153,7 @@ def qp_solve(Q: jax.Array, q: jax.Array, A: jax.Array, b: jax.Array,
     scale_d = 1.0 + jnp.max(jnp.abs(q))
 
     start = (z0, s0, lam0)
+    f32_ok = jnp.asarray(False)
     if n_f32 > 0:
         f32 = jnp.float32
         with jax.default_matmul_precision("highest"):
@@ -171,6 +176,7 @@ def qp_solve(Q: jax.Array, q: jax.Array, A: jax.Array, b: jax.Array,
 
         m_warm = merit(warm)
         ok = jnp.isfinite(m_warm) & (m_warm <= merit(start))
+        f32_ok = ok
         start = tuple(jnp.where(ok, w, c) for w, c in zip(warm, start))
 
     body = _make_body(Q, q, A, b)
@@ -187,7 +193,7 @@ def qp_solve(Q: jax.Array, q: jax.Array, A: jax.Array, b: jax.Array,
     converged = finite & (r_p < tol) & (r_d < tol) & (gap < tol)
     feasible = finite & (r_p < jnp.sqrt(tol))
     return QPSolution(z=z, lam=lam, s=s, obj=obj, rp=r_p, rd=r_d, gap=gap,
-                      converged=converged, feasible=feasible)
+                      converged=converged, feasible=feasible, f32_ok=f32_ok)
 
 
 def phase1(A: jax.Array, b: jax.Array, n_iter: int = 30,

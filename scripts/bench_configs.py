@@ -28,15 +28,25 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from bench import choose_backend, log, warm_oracle  # noqa: E402
 
-# (BASELINE.md row, problem name, constructor kwargs, eps_a)
+# (BASELINE.md row, problem name, constructor kwargs, eps_a, eps_r)
+#
+# Tolerances are PER CONFIG, matched to each problem's cost scale --
+# BASELINE.md pins eps only for the pendulum north star (1e-2).  The
+# certificate passes when gap <= eps_a OR gap <= eps_r*min|V*| (certify.
+# _passes), so eps_a covers the small-V region near the origin (where a
+# relative test needs infinite depth) and eps_r covers the far field
+# (where mass_spring's V reaches ~75 and an absolute 1e-2 would need
+# ~1e9 simplices -- measured secant-gap scaling, round 3).
 CONFIGS = [
-    ("1. double integrator (2s, 1i, N=5)", "double_integrator", {}, 1e-2),
-    ("2. mass-spring mp-QP (4s, N=10)", "mass_spring", {}, 1e-2),
-    ("3. inverted pendulum PWA mp-MIQP", "inverted_pendulum", {}, 1e-2),
+    ("1. double integrator (2s, 1i, N=5)", "double_integrator",
+     {}, 1e-2, 0.0),
+    ("2. mass-spring mp-QP (4s, N=10)", "mass_spring", {}, 1.0, 0.1),
+    ("3. inverted pendulum PWA mp-MIQP", "inverted_pendulum",
+     {}, 1e-2, 0.0),
     ("4. satellite desaturation (6s, 27 deltas)", "satellite",
-     {"axes": 3}, 1e-2),
+     {"axes": 3}, 1.0, 0.1),
     ("5. quadrotor obstacle avoidance (4-D pv, 16 deltas)", "quadrotor",
-     {"param": "pv"}, 1e-2),
+     {"param": "pv"}, 1.0, 0.1),
 ]
 
 
@@ -61,7 +71,7 @@ def main() -> int:
     from explicit_hybrid_mpc_tpu.partition.frontier import build_partition
     from explicit_hybrid_mpc_tpu.post import analysis
     from explicit_hybrid_mpc_tpu.problems.registry import make
-    for label, name, kwargs, eps_a in CONFIGS:
+    for label, name, kwargs, eps_a, eps_r in CONFIGS:
         if only_names and name not in only_names:
             continue
         log(f"== {label} ==")
@@ -81,7 +91,7 @@ def main() -> int:
             oracle.n_solves = oracle.n_point_solves = 0
             oracle.n_simplex_solves = 0
 
-            cfg = PartitionConfig(problem=name, eps_a=eps_a,
+            cfg = PartitionConfig(problem=name, eps_a=eps_a, eps_r=eps_r,
                                   backend="device", batch_simplices=512,
                                   max_steps=50_000, precision=precision,
                                   time_budget_s=budget)
@@ -90,7 +100,7 @@ def main() -> int:
             report = analysis.partition_report(res.tree, res.roots)
             row = {
                 "label": label, "problem": name, "kwargs": kwargs,
-                "eps_a": eps_a,
+                "eps_a": eps_a, "eps_r": eps_r,
                 "n_theta": problem.n_theta,
                 "n_delta": problem.canonical.n_delta,
                 "regions": stats["regions"],

@@ -1,0 +1,190 @@
+"""Mixed-precision validation on the live backend (round-2 verdict item 6:
+the f32-bulk + f64-polish schedule has only ever been validated on CPU;
+its on-chip numerics -- warm-start acceptance rate, any extra splits --
+must be a committed artifact).
+
+Produces `artifacts/precision_<platform>.json` with:
+
+1. `f32_accept_rate`: fraction of vmapped qp_solve instances (sampled
+   thetas x all commutations of the flagship problem) whose f32 warm
+   start passes the f64 merit gate (ipm.qp_solve `f32_ok`).  On TPU the
+   f32 phase runs under matmul-precision HIGHEST; a low rate here means
+   the f32 phase is wasted work and the schedule needs retuning.
+2. `mixed_vs_f64_regions_equal`: region AND tree-node parity between a
+   precision='mixed' and a precision='f64' partition build of the same
+   problem at PREC_EPS on this backend -- the split/certify decisions of
+   the schedule must match pure f64 (merit gate soundness, end to end).
+3. KKT residual statistics of both schedules on the sampled instances.
+
+Env: PREC_OUT, PREC_PROBLEM (default inverted_pendulum), PREC_EPS
+(default 0.1), PREC_POINTS (default 256), PREC_TIME_BUDGET (s, default
+1200 per build), plus bench.py's BENCH_PLATFORM / BENCH_PROBE_TIMEOUT.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from bench import (choose_backend, log, retry_transient,  # noqa: E402
+                   warm_oracle)
+
+
+def run(result: dict) -> None:
+    problem_name = os.environ.get("PREC_PROBLEM", "inverted_pendulum")
+    eps_a = float(os.environ.get("PREC_EPS", "0.1"))
+    n_points = int(os.environ.get("PREC_POINTS", "256"))
+    budget = float(os.environ.get("PREC_TIME_BUDGET", "1200"))
+    platform = choose_backend(result)
+    on_acc = platform != "cpu"
+
+    import jax
+    import jax.numpy as jnp
+
+    from explicit_hybrid_mpc_tpu.config import PartitionConfig
+    from explicit_hybrid_mpc_tpu.oracle import ipm
+    from explicit_hybrid_mpc_tpu.oracle.oracle import Oracle
+    from explicit_hybrid_mpc_tpu.partition.frontier import build_partition
+    from explicit_hybrid_mpc_tpu.problems.registry import make
+
+    problem = make(problem_name)
+    can = problem.canonical
+    nd = can.n_delta
+    result["problem"] = problem_name
+    result["n_delta"] = nd
+
+    # -- 1. f32 warm-start acceptance rate, straight from the IPM ---------
+    dev_backend = "device" if on_acc else "cpu"
+    probe_oracle = Oracle(problem, backend=dev_backend, precision="mixed")
+    prob_dev = probe_oracle.prob
+    n_f32, n_iter = probe_oracle.n_f32, probe_oracle.n_iter
+
+    def solve_one(theta, d):
+        q = prob_dev.F[d] @ theta + prob_dev.f[d]
+        b = prob_dev.w[d] + prob_dev.S[d] @ theta
+        return ipm.qp_solve(prob_dev.H[d], q, prob_dev.G[d], b,
+                            n_iter=n_iter, n_f32=n_f32)
+
+    rng = np.random.default_rng(7)
+    thetas = jnp.asarray(rng.uniform(problem.theta_lb, problem.theta_ub,
+                                     size=(n_points, problem.n_theta)))
+    ds = jnp.arange(nd)
+    solve_grid = jax.jit(jax.vmap(jax.vmap(solve_one, in_axes=(None, 0)),
+                                  in_axes=(0, None)))
+    sol = retry_transient(lambda: solve_grid(thetas, ds),
+                          what="f32-accept grid solve")
+    f32_ok = np.asarray(sol.f32_ok)
+    conv = np.asarray(sol.converged)
+    result["sampled_instances"] = int(f32_ok.size)
+    result["f32_accept_rate"] = round(float(f32_ok.mean()), 4)
+    result["f32_accept_rate_converged"] = round(
+        float(f32_ok[conv].mean()) if conv.any() else 0.0, 4)
+    result["mixed_kkt"] = {
+        "rp_max": float(np.asarray(sol.rp)[conv].max()) if conv.any() else None,
+        "rd_max": float(np.asarray(sol.rd)[conv].max()) if conv.any() else None,
+        "converged_frac": round(float(conv.mean()), 4),
+    }
+    log(f"f32 accept rate: {result['f32_accept_rate']} over "
+        f"{f32_ok.size} instances (converged frac "
+        f"{result['mixed_kkt']['converged_frac']})")
+
+    # pure-f64 comparison on the same instances
+    del probe_oracle
+    f64_oracle = Oracle(problem, backend=dev_backend, precision="f64")
+    prob_dev = f64_oracle.prob
+    n_f32b, n_iterb = f64_oracle.n_f32, f64_oracle.n_iter
+
+    def solve_one64(theta, d):
+        q = prob_dev.F[d] @ theta + prob_dev.f[d]
+        b = prob_dev.w[d] + prob_dev.S[d] @ theta
+        return ipm.qp_solve(prob_dev.H[d], q, prob_dev.G[d], b,
+                            n_iter=n_iterb, n_f32=n_f32b)
+
+    solve_grid64 = jax.jit(jax.vmap(jax.vmap(solve_one64, in_axes=(None, 0)),
+                                    in_axes=(0, None)))
+    sol64 = retry_transient(lambda: solve_grid64(thetas, ds),
+                            what="f64 grid solve")
+    conv64 = np.asarray(sol64.converged)
+    result["f64_kkt"] = {
+        "rp_max": (float(np.asarray(sol64.rp)[conv64].max())
+                   if conv64.any() else None),
+        "rd_max": (float(np.asarray(sol64.rd)[conv64].max())
+                   if conv64.any() else None),
+        "converged_frac": round(float(conv64.mean()), 4),
+    }
+    both = conv & conv64
+    dV = np.abs(np.asarray(sol.obj) - np.asarray(sol64.obj))[both]
+    result["convergence_agree_frac"] = round(float((conv == conv64).mean()), 4)
+    result["max_obj_diff_mixed_vs_f64"] = float(dV.max()) if dV.size else None
+    log(f"mixed vs f64: conv agree {result['convergence_agree_frac']}, "
+        f"max|dV| {result['max_obj_diff_mixed_vs_f64']}")
+
+    # -- 2. end-to-end region parity: mixed vs f64 build -------------------
+    # Each build is engine-protected (CPU-fallback retry inside the
+    # frontier); the warmups get retry_transient.  A failure in one
+    # precision's build still ships section 1 + the other build: the
+    # counts dict is written into result before the comparison.
+    counts = {}
+    result["builds"] = counts
+    for precision in ("mixed", "f64"):
+        orc = Oracle(problem, backend=dev_backend, precision=precision,
+                     points_cap=2048 if on_acc else 256)
+        warm_oracle(orc, problem)
+        cfg = PartitionConfig(problem=problem_name, eps_a=eps_a,
+                              backend="device", batch_simplices=256,
+                              max_steps=50_000, precision=precision,
+                              time_budget_s=budget)
+        t0 = time.time()
+        res = build_partition(problem, cfg, oracle=orc)
+        counts[precision] = {
+            "regions": res.stats["regions"],
+            "tree_nodes": res.stats["tree_nodes"],
+            "truncated": res.stats["truncated"],
+            "wall_s": round(res.stats["wall_s"], 2),
+            "regions_per_s": round(res.stats["regions_per_s"], 2),
+            "device_failures": res.stats["device_failures"],
+        }
+        log(f"  {precision}: {counts[precision]} ({time.time()-t0:.0f}s)")
+    both_complete = not (counts["mixed"]["truncated"]
+                         or counts["f64"]["truncated"])
+    result["parity_valid"] = both_complete
+    result["mixed_vs_f64_regions_equal"] = (
+        both_complete
+        and counts["mixed"]["regions"] == counts["f64"]["regions"]
+        and counts["mixed"]["tree_nodes"] == counts["f64"]["tree_nodes"])
+    result["mixed_speedup_vs_f64"] = (
+        round(counts["f64"]["wall_s"] / counts["mixed"]["wall_s"], 2)
+        if counts["mixed"]["wall_s"] else None)
+
+
+def main() -> int:
+    platform_guess = os.environ.get("BENCH_PLATFORM", "auto")
+    result: dict = {"captured_at": time.strftime("%Y-%m-%d %H:%M:%S")}
+    try:
+        run(result)
+    except BaseException as e:
+        import traceback
+
+        result["error"] = repr(e)
+        traceback.print_exc(file=sys.stderr)
+    finally:
+        out_path = os.environ.get(
+            "PREC_OUT",
+            f"artifacts/precision_{result.get('platform', platform_guess)}"
+            ".json")
+        os.makedirs(os.path.dirname(out_path) or ".", exist_ok=True)
+        with open(out_path, "w") as f:
+            json.dump(result, f, indent=2)
+        print(json.dumps(result))
+    return 0 if ("error" not in result
+                 and result.get("mixed_vs_f64_regions_equal")) else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -32,6 +32,7 @@ def test_north_star_smoke(tmp_path):
     out = str(tmp_path / "north_star.json")
     data = _run("scripts/north_star.py", {
         "NORTH_STAR_OUT": out,
+        "NS_LOG": str(tmp_path / "ns.log.jsonl"),
         "NS_PROBLEM": "double_integrator",
         "NS_TIME_BUDGET": "45",
         "NS_PARITY_EPS": "0.5",
@@ -59,6 +60,23 @@ def test_bench_configs_smoke(tmp_path):
     assert "error" not in rows[0], rows[0]
     assert rows[0]["regions"] > 0
     assert 0.0 < rows[0]["volume_certified_frac"] <= 1.0
+
+
+def test_precision_check_smoke(tmp_path):
+    out = str(tmp_path / "precision.json")
+    data = _run("scripts/precision_check.py", {
+        "PREC_OUT": out,
+        "PREC_PROBLEM": "double_integrator",
+        "PREC_POINTS": "16",
+        "PREC_EPS": "0.3",
+        "PREC_TIME_BUDGET": "90",
+    }, out)
+    assert data["platform"] == "cpu"
+    assert 0.0 <= data["f32_accept_rate"] <= 1.0
+    assert data["mixed_kkt"]["converged_frac"] > 0.5
+    assert data["f64_kkt"]["converged_frac"] > 0.5
+    assert data["parity_valid"] is True
+    assert data["mixed_vs_f64_regions_equal"] is True, data["builds"]
 
 
 def test_online_crossover_smoke(tmp_path):

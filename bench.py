@@ -145,10 +145,12 @@ def retry_transient(fn, attempts: int = 3, wait_s: float = 20.0,
 
 
 def warm_oracle(oracle, problem, stop_after: float | None = None) -> None:
-    """Compile every power-of-two vertex-batch bucket up front so compile
-    time stays out of the timed region.  `stop_after`: optional epoch
-    deadline -- an unwarmed bucket just lands its compile inside the timed
-    build (lower number, never a void)."""
+    """Compile every vertex-batch AND simplex-batch bucket up front so
+    compile time stays out of the timed region.  Mid-run bucket compiles
+    through the axon tunnel cost 1-2 minutes each (the 114 s step-time
+    outlier in artifacts/north_star.log.jsonl was exactly this).
+    `stop_after`: optional epoch deadline -- an unwarmed bucket just lands
+    its compile inside the timed build (lower number, never a void)."""
     rng = np.random.default_rng(42)
     b = 8
     while b <= oracle.max_points_per_call:
@@ -160,6 +162,26 @@ def warm_oracle(oracle, problem, stop_after: float | None = None) -> None:
                           size=(b, problem.n_theta))
         retry_transient(lambda: oracle.solve_vertices(pts),
                         what=f"warmup bucket {b}")
+        b *= 2
+    # Simplex-query buckets (solve_simplex_min warms both the min-QP and
+    # the phase-1 program; simplex_feasibility reuses the latter).
+    from explicit_hybrid_mpc_tpu.partition import geometry
+
+    span = problem.theta_ub - problem.theta_lb
+    V0 = np.vstack([problem.theta_lb,
+                    problem.theta_lb + 0.1 * np.diag(span)])
+    M1 = geometry.barycentric_matrix(V0)
+    nd = problem.canonical.n_delta
+    b = 8
+    while b <= oracle.max_simplex_rows_per_call:
+        if stop_after is not None and time.time() > stop_after:
+            log(f"warmup stopped early at simplex bucket {b}")
+            break
+        log(f"warmup: simplex bucket {b}")
+        Ms = np.tile(M1[None], (b, 1, 1))
+        ds = (np.arange(b, dtype=np.int64) % nd)
+        retry_transient(lambda: oracle.solve_simplex_min(Ms, ds),
+                        what=f"simplex warmup {b}")
         b *= 2
 
 

@@ -209,7 +209,8 @@ class Oracle:
 
     def __init__(self, problem, backend: str = "cpu", n_iter: int = 30,
                  mesh=None, precision: str = "f64",
-                 points_cap: int | None = None):
+                 points_cap: int | None = None,
+                 n_f32: int | None = None):
         """mesh: optional jax.sharding.Mesh with ("batch", "delta") axes;
         when given, solve_vertices shards the (points x commutations) grid
         over it (parallel/mesh.py) instead of running on a single device --
@@ -235,8 +236,14 @@ class Oracle:
         self.points_cap = points_cap
         # Mixed precision splits the caller's iteration budget 2:1 between
         # the f32 bulk and the f64 polish (default n_iter=30 -> 20 + 10);
-        # hard-coding the polish count would silently ignore n_iter.
-        self.n_f32 = (2 * n_iter) // 3 if precision == "mixed" else 0
+        # hard-coding the polish count would silently ignore n_iter.  An
+        # explicit n_f32 overrides the split (schedule tuning: on TPU the
+        # f64 polish is emulated ~10x, so its count dominates solve time;
+        # scripts/tune_schedule.py measures safe minima).
+        if n_f32 is not None and precision != "mixed":
+            raise ValueError("n_f32 override requires precision='mixed'")
+        self.n_f32 = ((2 * n_iter) // 3 if n_f32 is None else n_f32) \
+            if precision == "mixed" else 0
         self.n_iter = n_iter - self.n_f32
         self.mesh = mesh
         # Statistics: individual QP solves issued, split by kind -- the
@@ -359,6 +366,32 @@ class Oracle:
 
     # -- the simplex-wide bound query (reference: V_R-style) ---------------
 
+    # Simplex-query batches pad to power-of-two buckets CAPPED at this many
+    # rows; larger batches are chunked.  Uncapped padding compiled a fresh
+    # program at every new frontier-driven bucket (2048, 4096, ... -- each
+    # a ~1-2 min remote compile mid-build: the step-time outliers in
+    # artifacts/north_star.log.jsonl), and those giant shapes were compiled
+    # exactly once per run.  The cap bounds the compiled-shape set to
+    # {8..cap}, all warmable up front (bench.warm_oracle).
+    max_simplex_rows_per_call: int = 1024
+
+    def simplex_bucket(self, K: int) -> int:
+        """Padded row count for a K-row simplex query: power-of-two,
+        capped at max_simplex_rows_per_call -- at the default cap that is
+        8 compiled shapes {8..1024} per program, all warmable up front
+        (bench.warm_oracle).  Padding waste costs device microseconds; an
+        extra compiled shape costs a ~minute remote compile mid-run."""
+        return max(8, min(self.max_simplex_rows_per_call,
+                          1 << (K - 1).bit_length()))
+
+    def _pad_simplex(self, Ms: np.ndarray, ds: np.ndarray):
+        K = Ms.shape[0]
+        Kpad = self.simplex_bucket(K)
+        Mpad = np.concatenate(
+            [Ms, np.tile(np.eye(Ms.shape[1])[None], (Kpad - K, 1, 1))])
+        dpad = np.concatenate([ds, np.zeros(Kpad - K, dtype=np.int64)])
+        return jnp.asarray(Mpad), jnp.asarray(dpad)
+
     def solve_simplex_min(self, bary_Ms: np.ndarray,
                           delta_idx: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
         """min_{theta in R} V_delta(theta) for a batch of (simplex, delta).
@@ -376,21 +409,23 @@ class Oracle:
             return np.zeros(0), np.zeros(0, dtype=bool)
         self.n_solves += 2 * K
         self.n_simplex_solves += 2 * K
-        Kpad = max(8, 1 << (K - 1).bit_length())
-        Mpad = np.concatenate(
-            [bary_Ms, np.tile(np.eye(bary_Ms.shape[1])[None],
-                              (Kpad - K, 1, 1))])
-        dpad = np.concatenate([delta_idx, np.zeros(Kpad - K, dtype=np.int64)])
-        Mj, dj = jnp.asarray(Mpad), jnp.asarray(dpad)
-        V, conv, _feas = self._simplex_min(Mj, dj)
-        t, t_conv, farkas = self._simplex_feas(Mj, dj)
-        V, conv = np.asarray(V), np.asarray(conv)
-        t, t_conv = np.asarray(t), np.asarray(t_conv)
-        infeasible = t_conv & (t > 1e-6) & np.asarray(farkas)
-        feasible_somewhere = t_conv & (t <= 1e-6)
-        out = np.where(conv, V, -_INF)
-        out = np.where(infeasible, _INF, out)
-        return out[:K], feasible_somewhere[:K]
+        cap = self.max_simplex_rows_per_call
+        outs, feas_sw = [], []
+        for lo in range(0, K, cap):
+            Mj, dj = self._pad_simplex(bary_Ms[lo:lo + cap],
+                                       delta_idx[lo:lo + cap])
+            Kc = min(cap, K - lo)
+            V, conv, _feas = self._simplex_min(Mj, dj)
+            t, t_conv, farkas = self._simplex_feas(Mj, dj)
+            V, conv = np.asarray(V)[:Kc], np.asarray(conv)[:Kc]
+            t, t_conv = np.asarray(t)[:Kc], np.asarray(t_conv)[:Kc]
+            infeasible = t_conv & (t > 1e-6) & np.asarray(farkas)[:Kc]
+            feasible_somewhere = t_conv & (t <= 1e-6)
+            out = np.where(conv, V, -_INF)
+            out = np.where(infeasible, _INF, out)
+            outs.append(out)
+            feas_sw.append(feasible_somewhere)
+        return np.concatenate(outs), np.concatenate(feas_sw)
 
     def simplex_feasibility(self, bary_Ms: np.ndarray,
                             delta_idx: np.ndarray
@@ -409,19 +444,21 @@ class Oracle:
             return z, z.astype(bool), z.astype(bool)
         self.n_solves += K
         self.n_simplex_solves += K
-        Kpad = max(8, 1 << (K - 1).bit_length())
-        Mpad = np.concatenate(
-            [bary_Ms, np.tile(np.eye(bary_Ms.shape[1])[None],
-                              (Kpad - K, 1, 1))])
-        dpad = np.concatenate([np.asarray(delta_idx, dtype=np.int64),
-                               np.zeros(Kpad - K, dtype=np.int64)])
-        t, conv, farkas = self._simplex_feas(jnp.asarray(Mpad),
-                                             jnp.asarray(dpad))
-        t, conv, farkas = (np.asarray(t), np.asarray(conv),
-                           np.asarray(farkas))
-        feas_somewhere = conv & (t <= 1e-6)
-        infeas_cert = conv & (t > 1e-6) & farkas
-        return t[:K], feas_somewhere[:K], infeas_cert[:K]
+        delta_idx = np.asarray(delta_idx, dtype=np.int64)
+        cap = self.max_simplex_rows_per_call
+        ts, feas_sw, infeas = [], [], []
+        for lo in range(0, K, cap):
+            Mj, dj = self._pad_simplex(bary_Ms[lo:lo + cap],
+                                       delta_idx[lo:lo + cap])
+            Kc = min(cap, K - lo)
+            t, conv, farkas = self._simplex_feas(Mj, dj)
+            t, conv, farkas = (np.asarray(t)[:Kc], np.asarray(conv)[:Kc],
+                               np.asarray(farkas)[:Kc])
+            ts.append(t)
+            feas_sw.append(conv & (t <= 1e-6))
+            infeas.append(conv & (t > 1e-6) & farkas)
+        return (np.concatenate(ts), np.concatenate(feas_sw),
+                np.concatenate(infeas))
 
     # -- fixed-commutation point solve (the semi-explicit ONLINE stage) ----
 

@@ -143,6 +143,10 @@ class FrontierEngine:
                 self.problem, backend="cpu",
                 n_iter=self.oracle.n_iter + self.oracle.n_f32,
                 precision=self.oracle.precision,
+                # Mirror an overridden f32/f64 split exactly, else the
+                # fallback's results drift from the main oracle's.
+                n_f32=(self.oracle.n_f32
+                       if self.oracle.precision == "mixed" else None),
                 points_cap=self.oracle.points_cap)
         return self._fb_oracle
 

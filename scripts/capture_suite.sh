@@ -1,0 +1,40 @@
+#!/bin/bash
+# Round-3 manual capture sequence (replaces one tpu_watch cycle with
+# builder-chosen budgets).  Run under nohup; each step writes its artifact
+# + log and commits them (pathspec-limited so concurrent builder commits
+# are untouched).  A step that dies moves on -- every capture script
+# ships a partial artifact by design.
+cd /root/repo || exit 1
+
+commit() {
+  git add artifacts 2>/dev/null
+  git diff --cached --quiet -- artifacts || \
+    git commit -m "Capture TPU benchmark artifacts ($1)" -- artifacts
+}
+
+echo "[capture_suite] north_star (flagship 3600s + parity eps 0.2)"
+NS_TIME_BUDGET=3600 NS_PARITY_EPS=0.2 timeout 9000 \
+  python scripts/north_star.py > artifacts/north_star.log 2>&1
+commit north_star
+
+echo "[capture_suite] online crossover (deep eps list incl >=1e5 leaves)"
+CROSS_EPS="0.5,0.2,0.1,0.05,0.02,0.01,0.005" timeout 7200 \
+  python scripts/online_crossover.py > artifacts/online_crossover.log 2>&1
+commit crossover
+
+echo "[capture_suite] bench (idle-host recapture)"
+BENCH_OUT=artifacts/bench_tpu.json timeout 1800 \
+  python bench.py > artifacts/bench_tpu.log 2>&1
+commit bench
+
+echo "[capture_suite] per-config table (per-config eps, 600s each)"
+CFG_TIME_BUDGET=600 timeout 7200 \
+  python scripts/bench_configs.py > artifacts/configs.log 2>&1
+commit configs
+
+echo "[capture_suite] precision check (mixed vs f64 on chip)"
+PREC_TIME_BUDGET=1500 timeout 7200 \
+  python scripts/precision_check.py > artifacts/precision.log 2>&1
+commit precision
+
+echo "[capture_suite] done"

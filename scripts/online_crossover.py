@@ -75,7 +75,9 @@ def main() -> int:
     for eps in eps_list:
         # Per-eps isolation: a transient tunnel/compile failure (observed
         # r3: remote_compile HTTP 500 killed the deep rows) must cost one
-        # row, not every row after it.
+        # row, not every row after it -- and measurements taken BEFORE the
+        # failure stay in the row (built incrementally).
+        row = {"eps_a": eps}
         try:
             cfg = PartitionConfig(problem="double_integrator", eps_a=eps,
                                   backend="device", batch_simplices=512,
@@ -86,11 +88,9 @@ def main() -> int:
             dev = evaluator.stage(table)
             t0 = time.perf_counter()
             dt = descent.export_descent(res.tree, res.roots, table)
-            export_s = time.perf_counter() - t0
-            row = {"eps_a": eps, "leaves": table.n_leaves,
-                   "max_depth": dt.max_depth,
-                   "descent_export_s": round(export_s, 3),
-                   "truncated": res.stats["truncated"]}
+            row.update(leaves=table.n_leaves, max_depth=dt.max_depth,
+                       descent_export_s=round(time.perf_counter() - t0, 3),
+                       truncated=res.stats["truncated"])
             row["jax_us"] = round(
                 time_fn(lambda q: evaluator.evaluate(dev, q), qs)
                 / B * 1e6, 4)
@@ -102,8 +102,17 @@ def main() -> int:
                 row["pallas_us"] = round(
                     time_fn(lambda q: pallas_eval.locate(pt, q), qs)
                     / B * 1e6, 4)
+                # Machine-checked Mosaic evidence (round-2 verdict weak
+                # item 2): the REAL-compiled kernel's leaf choice must
+                # agree with the f64 brute-force evaluator on-chip, not
+                # just in interpret mode.
+                ev = evaluator.evaluate(dev, qs)
+                pl_idx, _score = pallas_eval.locate(pt, qs)
+                row["pallas_leaf_match_frac"] = round(
+                    float((np.asarray(pl_idx)
+                           == np.asarray(ev.leaf)).mean()), 6)
         except (RuntimeError, OSError) as e:
-            row = {"eps_a": eps, "error": repr(e)[:300]}
+            row["error"] = repr(e)[:300]
         log(f"  {row}")
         result["rows"].append(row)
         os.makedirs(os.path.dirname(out_path) or ".", exist_ok=True)

@@ -61,22 +61,28 @@ def run(result: dict) -> None:
 
     # -- 1. f32 warm-start acceptance rate, straight from the IPM ---------
     dev_backend = "device" if on_acc else "cpu"
-    probe_oracle = Oracle(problem, backend=dev_backend, precision="mixed")
-    prob_dev = probe_oracle.prob
-    n_f32, n_iter = probe_oracle.n_f32, probe_oracle.n_iter
 
-    def solve_one(theta, d):
-        q = prob_dev.F[d] @ theta + prob_dev.f[d]
-        b = prob_dev.w[d] + prob_dev.S[d] @ theta
-        return ipm.qp_solve(prob_dev.H[d], q, prob_dev.G[d], b,
-                            n_iter=n_iter, n_f32=n_f32)
+    def make_grid_solver(oracle):
+        """Jitted (points x deltas) raw qp_solve grid bound to ONE
+        oracle's staged problem + schedule (avoids the duplicated-closure
+        / late-binding hazard flagged by code review)."""
+        prob_dev, n_it, nf = oracle.prob, oracle.n_iter, oracle.n_f32
+
+        def solve_one(theta, d):
+            q = prob_dev.F[d] @ theta + prob_dev.f[d]
+            b = prob_dev.w[d] + prob_dev.S[d] @ theta
+            return ipm.qp_solve(prob_dev.H[d], q, prob_dev.G[d], b,
+                                n_iter=n_it, n_f32=nf)
+
+        return jax.jit(jax.vmap(jax.vmap(solve_one, in_axes=(None, 0)),
+                                in_axes=(0, None)))
 
     rng = np.random.default_rng(7)
     thetas = jnp.asarray(rng.uniform(problem.theta_lb, problem.theta_ub,
                                      size=(n_points, problem.n_theta)))
     ds = jnp.arange(nd)
-    solve_grid = jax.jit(jax.vmap(jax.vmap(solve_one, in_axes=(None, 0)),
-                                  in_axes=(0, None)))
+    solve_grid = make_grid_solver(
+        Oracle(problem, backend=dev_backend, precision="mixed"))
     sol = retry_transient(lambda: solve_grid(thetas, ds),
                           what="f32-accept grid solve")
     f32_ok = np.asarray(sol.f32_ok)
@@ -95,19 +101,8 @@ def run(result: dict) -> None:
         f"{result['mixed_kkt']['converged_frac']})")
 
     # pure-f64 comparison on the same instances
-    del probe_oracle
-    f64_oracle = Oracle(problem, backend=dev_backend, precision="f64")
-    prob_dev = f64_oracle.prob
-    n_f32b, n_iterb = f64_oracle.n_f32, f64_oracle.n_iter
-
-    def solve_one64(theta, d):
-        q = prob_dev.F[d] @ theta + prob_dev.f[d]
-        b = prob_dev.w[d] + prob_dev.S[d] @ theta
-        return ipm.qp_solve(prob_dev.H[d], q, prob_dev.G[d], b,
-                            n_iter=n_iterb, n_f32=n_f32b)
-
-    solve_grid64 = jax.jit(jax.vmap(jax.vmap(solve_one64, in_axes=(None, 0)),
-                                    in_axes=(0, None)))
+    solve_grid64 = make_grid_solver(
+        Oracle(problem, backend=dev_backend, precision="f64"))
     sol64 = retry_transient(lambda: solve_grid64(thetas, ds),
                             what="f64 grid solve")
     conv64 = np.asarray(sol64.converged)

@@ -1,0 +1,167 @@
+"""Mixed-precision schedule sweep: find the cheapest (n_f32, n_f64) pair
+that still converges and preserves partition parity.
+
+On TPU, f64 is emulated at ~10x the f32 cost, so the f64 polish count
+dominates oracle solve time even in the 'mixed' schedule (20 f32 + 10
+f64: the polish is ~80% of the arithmetic).  This sweep measures, per
+schedule, on the live backend:
+
+- point-grid solve wall time per QP (pendulum, P points x 32 deltas);
+- converged fraction + worst KKT residuals among converged instances;
+- joint simplex-min batch wall time per QP;
+- for schedules that look safe (converged_frac within 1e-3 of the
+  baseline), an end-to-end region-parity build at TUNE_EPS vs the
+  default schedule.
+
+Writes artifacts/tune_schedule.json.  Env: TUNE_OUT, TUNE_POINTS
+(default 512), TUNE_EPS (default 0.2), TUNE_PROBLEM, TUNE_BUILD_BUDGET
+(s, default 900), plus bench.py's BENCH_PLATFORM / BENCH_PROBE_TIMEOUT.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from bench import choose_backend, log, retry_transient  # noqa: E402
+
+# (n_f32, n_f64) candidates; (20, 10) is the shipping default.
+SCHEDULES = [(20, 10), (20, 6), (20, 4), (24, 4), (16, 6), (0, 30)]
+
+
+def run(result: dict) -> None:
+    problem_name = os.environ.get("TUNE_PROBLEM", "inverted_pendulum")
+    n_points = int(os.environ.get("TUNE_POINTS", "512"))
+    eps_a = float(os.environ.get("TUNE_EPS", "0.2"))
+    build_budget = float(os.environ.get("TUNE_BUILD_BUDGET", "900"))
+    platform = choose_backend(result)
+    on_acc = platform != "cpu"
+
+    import jax
+    import jax.numpy as jnp
+
+    from explicit_hybrid_mpc_tpu.config import PartitionConfig
+    from explicit_hybrid_mpc_tpu.oracle.oracle import Oracle
+    from explicit_hybrid_mpc_tpu.partition import geometry
+    from explicit_hybrid_mpc_tpu.partition.frontier import build_partition
+    from explicit_hybrid_mpc_tpu.problems.registry import make
+
+    problem = make(problem_name)
+    nd = problem.canonical.n_delta
+    result["problem"] = problem_name
+    result["n_delta"] = nd
+    result["n_points"] = n_points
+    rng = np.random.default_rng(11)
+    thetas = np.asarray(rng.uniform(problem.theta_lb, problem.theta_ub,
+                                    size=(n_points, problem.n_theta)))
+
+    # One shared simplex-min batch (64 simplices spread over the box).
+    span = problem.theta_ub - problem.theta_lb
+    Ms = []
+    for k in range(64):
+        lo = problem.theta_lb + 0.8 * span * rng.random(problem.n_theta)
+        V = np.vstack([lo, lo + 0.1 * np.diag(span)])
+        Ms.append(geometry.barycentric_matrix(V))
+    Ms = np.stack(Ms)
+    ds64 = np.arange(64, dtype=np.int64) % nd
+
+    dev_backend = "device" if on_acc else "cpu"
+    rows = []
+    result["schedules"] = rows
+    base_conv = None
+    for n_f32, n_f64 in SCHEDULES:
+        precision = "f64" if n_f32 == 0 else "mixed"
+        orc = Oracle(problem, backend=dev_backend,
+                     n_iter=n_f32 + n_f64, precision=precision,
+                     n_f32=n_f32 if precision == "mixed" else None,
+                     points_cap=2048 if on_acc else 256)
+        row = {"n_f32": n_f32, "n_f64": n_f64}
+        try:
+            sol = retry_transient(lambda: orc.solve_vertices(thetas),
+                                  what=f"warm {n_f32}+{n_f64}")  # compile
+            t0 = time.perf_counter()
+            sol = orc.solve_vertices(thetas)
+            dt = time.perf_counter() - t0
+            conv = np.asarray(sol.conv)
+            row["point_us_per_qp"] = round(dt / (n_points * nd) * 1e6, 3)
+            row["converged_frac"] = round(float(conv.mean()), 5)
+            # Simplex-min batch (the structurally larger joint QP).
+            retry_transient(lambda: orc.solve_simplex_min(Ms, ds64),
+                            what=f"simplex warm {n_f32}+{n_f64}")
+            t0 = time.perf_counter()
+            orc.solve_simplex_min(Ms, ds64)
+            dt2 = time.perf_counter() - t0
+            # solve_simplex_min runs a min-QP + phase-1 per row.
+            row["simplex_us_per_qp"] = round(dt2 / (2 * len(Ms)) * 1e6, 3)
+            if base_conv is None:
+                base_conv = row["converged_frac"]
+            row["conv_ok"] = row["converged_frac"] >= base_conv - 1e-3
+        except (RuntimeError, OSError) as e:
+            row["error"] = repr(e)[:300]
+        log(f"  {row}")
+        rows.append(row)
+
+    # Parity builds: default schedule vs the fastest conv_ok candidate.
+    ok_rows = [r for r in rows if r.get("conv_ok") and "error" not in r]
+    if len(ok_rows) >= 2:
+        fastest = min(ok_rows[1:], key=lambda r: r["point_us_per_qp"])
+        counts = {}
+        for tag, (nf, npol) in (("default", SCHEDULES[0]),
+                                ("fastest", (fastest["n_f32"],
+                                             fastest["n_f64"]))):
+            orc = Oracle(problem, backend=dev_backend, n_iter=nf + npol,
+                         precision="mixed", n_f32=nf,
+                         points_cap=2048 if on_acc else 256)
+            cfg = PartitionConfig(problem=problem_name, eps_a=eps_a,
+                                  backend="device", batch_simplices=256,
+                                  max_steps=50_000, precision="mixed",
+                                  time_budget_s=build_budget)
+            res = build_partition(problem, cfg, oracle=orc)
+            counts[tag] = {"schedule": [nf, npol],
+                           "regions": res.stats["regions"],
+                           "tree_nodes": res.stats["tree_nodes"],
+                           "truncated": res.stats["truncated"],
+                           "wall_s": round(res.stats["wall_s"], 2),
+                           "regions_per_s": round(
+                               res.stats["regions_per_s"], 2)}
+            log(f"  build {tag}: {counts[tag]}")
+        both = not (counts["default"]["truncated"]
+                    or counts["fastest"]["truncated"])
+        result["parity_builds"] = counts
+        result["parity_valid"] = both
+        result["fastest_parity_ok"] = (
+            both and counts["default"]["regions"]
+            == counts["fastest"]["regions"]
+            and counts["default"]["tree_nodes"]
+            == counts["fastest"]["tree_nodes"])
+        result["fastest_speedup"] = (
+            round(counts["default"]["wall_s"] / counts["fastest"]["wall_s"],
+                  2) if counts["fastest"]["wall_s"] else None)
+
+
+def main() -> int:
+    out_path = os.environ.get("TUNE_OUT", "artifacts/tune_schedule.json")
+    result: dict = {"captured_at": time.strftime("%Y-%m-%d %H:%M:%S")}
+    try:
+        run(result)
+    except BaseException as e:
+        import traceback
+
+        result["error"] = repr(e)
+        traceback.print_exc(file=sys.stderr)
+    finally:
+        os.makedirs(os.path.dirname(out_path) or ".", exist_ok=True)
+        with open(out_path, "w") as f:
+            json.dump(result, f, indent=2)
+        print(json.dumps(result))
+    return 0 if "error" not in result else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

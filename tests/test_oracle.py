@@ -70,6 +70,29 @@ def test_simplex_min_matches_vertex_min(oracle, di):
     assert Vmin[0] > 0.0  # cost is PD quadratic-ish, away from origin
 
 
+def test_simplex_chunking_matches_single_call(oracle, rng):
+    """Chunked simplex queries (cap < K) must return exactly what one
+    call returns -- the cap exists to bound compiled shapes, not to
+    change results."""
+    Vs = []
+    for k in range(40):
+        lo = rng.uniform(-0.5, 0.3, size=2)
+        Vs.append(np.vstack([lo, lo + [0.2, 0.0], lo + [0.0, 0.2]]))
+    Ms = np.stack([geometry.barycentric_matrix(V) for V in Vs])
+    ds = np.zeros(40, dtype=np.int64)
+    ref_min, ref_feas = oracle.solve_simplex_min(Ms, ds)
+    ref_t, ref_sw, ref_ic = oracle.simplex_feasibility(Ms, ds)
+    chunked = Oracle(oracle.problem, backend="cpu")
+    chunked.max_simplex_rows_per_call = 16  # forces 3 chunks
+    c_min, c_feas = chunked.solve_simplex_min(Ms, ds)
+    c_t, c_sw, c_ic = chunked.simplex_feasibility(Ms, ds)
+    np.testing.assert_array_equal(ref_min, c_min)
+    np.testing.assert_array_equal(ref_feas, c_feas)
+    np.testing.assert_array_equal(ref_t, c_t)
+    np.testing.assert_array_equal(ref_sw, c_sw)
+    np.testing.assert_array_equal(ref_ic, c_ic)
+
+
 class _Unconstrained(base.HybridMPC):
     """Zero-constraint problem: stack_slices must pad to nc=1 and the IPM
     must solve it exactly (review finding: zero-row crash)."""

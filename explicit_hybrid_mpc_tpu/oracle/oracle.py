@@ -242,6 +242,9 @@ class Oracle:
         # scripts/tune_schedule.py measures safe minima).
         if n_f32 is not None and precision != "mixed":
             raise ValueError("n_f32 override requires precision='mixed'")
+        if n_f32 is not None and not 0 <= n_f32 <= n_iter:
+            raise ValueError(f"n_f32={n_f32} must lie in [0, n_iter="
+                             f"{n_iter}] (the rest is the f64 polish)")
         self.n_f32 = ((2 * n_iter) // 3 if n_f32 is None else n_f32) \
             if precision == "mixed" else 0
         self.n_iter = n_iter - self.n_f32

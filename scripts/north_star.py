@@ -36,6 +36,16 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from bench import choose_backend, log, warm_oracle  # noqa: E402
 
 
+def _flush(result: dict) -> None:
+    """Write the artifact NOW: a tunnel hang (observed r3: a device call
+    that never returns, unkillable except by SIGKILL which skips
+    `finally`) must only lose the sections not yet captured."""
+    out_path = os.environ.get("NORTH_STAR_OUT", "artifacts/north_star.json")
+    os.makedirs(os.path.dirname(out_path) or ".", exist_ok=True)
+    with open(out_path, "w") as f:
+        json.dump(result, f, indent=2)
+
+
 def run(result: dict) -> None:
     precision = os.environ.get("NS_PRECISION", "mixed")
     parity_eps = float(os.environ.get("NS_PARITY_EPS", "0.1"))
@@ -110,6 +120,7 @@ def run(result: dict) -> None:
     result["flagship"]["serial_ms_per_solve"] = round(per_solve * 1e3, 3)
     result["flagship"]["vs_serial_estimate"] = round(
         serial_wall / stats["wall_s"], 2)
+    _flush(result)
 
     # -- 2. parity at a tractable epsilon ----------------------------------
     log(f"parity builds (eps_a={parity_eps}): device vs serial...")
@@ -126,7 +137,11 @@ def run(result: dict) -> None:
                            "tree_nodes": pres.stats["tree_nodes"],
                            "max_depth": pres.stats["max_depth"],
                            "truncated": pres.stats["truncated"],
-                           "wall_s": round(pres.stats["wall_s"], 2)}
+                           "wall_s": round(pres.stats["wall_s"], 2),
+                           "regions_per_s": round(
+                               pres.stats["regions_per_s"], 2)}
+        result["parity_partial"] = counts
+        _flush(result)
         log(f"  {backend}: {counts[backend]}")
     bk = "device" if on_acc else "cpu"
     both_complete = not (counts[bk]["truncated"]

@@ -35,6 +35,17 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from bench import (choose_backend, log, retry_transient,  # noqa: E402
                    warm_oracle)
 
+OUT_PATH = os.environ.get("PREC_OUT", "artifacts/precision.json")
+
+
+def _flush(result: dict) -> None:
+    """Incremental artifact write: a tunnel hang must only lose the
+    sections not yet captured (r3 lesson -- the flagship hang skipped
+    `finally` entirely under SIGKILL)."""
+    os.makedirs(os.path.dirname(OUT_PATH) or ".", exist_ok=True)
+    with open(OUT_PATH, "w") as f:
+        json.dump(result, f, indent=2)
+
 
 def run(result: dict) -> None:
     problem_name = os.environ.get("PREC_PROBLEM", "inverted_pendulum")
@@ -119,6 +130,7 @@ def run(result: dict) -> None:
     result["max_obj_diff_mixed_vs_f64"] = float(dV.max()) if dV.size else None
     log(f"mixed vs f64: conv agree {result['convergence_agree_frac']}, "
         f"max|dV| {result['max_obj_diff_mixed_vs_f64']}")
+    _flush(result)
 
     # -- 2. end-to-end region parity: mixed vs f64 build -------------------
     # Each build is engine-protected (CPU-fallback retry inside the
@@ -146,6 +158,7 @@ def run(result: dict) -> None:
             "device_failures": res.stats["device_failures"],
         }
         log(f"  {precision}: {counts[precision]} ({time.time()-t0:.0f}s)")
+        _flush(result)
     both_complete = not (counts["mixed"]["truncated"]
                          or counts["f64"]["truncated"])
     result["parity_valid"] = both_complete
@@ -159,7 +172,6 @@ def run(result: dict) -> None:
 
 
 def main() -> int:
-    platform_guess = os.environ.get("BENCH_PLATFORM", "auto")
     result: dict = {"captured_at": time.strftime("%Y-%m-%d %H:%M:%S")}
     try:
         run(result)
@@ -169,13 +181,7 @@ def main() -> int:
         result["error"] = repr(e)
         traceback.print_exc(file=sys.stderr)
     finally:
-        out_path = os.environ.get(
-            "PREC_OUT",
-            f"artifacts/precision_{result.get('platform', platform_guess)}"
-            ".json")
-        os.makedirs(os.path.dirname(out_path) or ".", exist_ok=True)
-        with open(out_path, "w") as f:
-            json.dump(result, f, indent=2)
+        _flush(result)
         print(json.dumps(result))
     return 0 if ("error" not in result
                  and result.get("mixed_vs_f64_regions_equal")) else 1

@@ -1,0 +1,137 @@
+"""Capture a jax.profiler trace of the frontier build and summarize it.
+
+Obligation: SURVEY.md section 6.1 + round-2 verdict item 7 ("capture one
+--profile trace on TPU and write up the findings").  Runs a short
+flagship build with profiling enabled on the live backend, then parses
+the TensorBoard trace (Chrome trace events) and writes
+`artifacts/profile.json` with:
+
+- platform, per-step JSONL stats (device_frac) of the profiled steps;
+- the top ops by total self-duration on the device track -- the direct
+  answer to "f64 emulation vs Cholesky vs host certify";
+- trace directory location (kept OUT of artifacts/: raw traces are tens
+  of MB; the summary is the committed evidence).
+
+Env: PROFILE_OUT, PROFILE_TRACE_DIR (default /tmp/jax_trace_profile),
+PROFILE_PROBLEM, PROFILE_EPS, PROFILE_STEPS (default 5),
+PROFILE_TIME_BUDGET, plus bench.py's BENCH_PLATFORM / BENCH_PROBE_TIMEOUT.
+"""
+
+from __future__ import annotations
+
+import glob
+import gzip
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from bench import choose_backend, log, warm_oracle  # noqa: E402
+
+OUT_PATH = os.environ.get("PROFILE_OUT", "artifacts/profile.json")
+
+
+def _flush(result: dict) -> None:
+    os.makedirs(os.path.dirname(OUT_PATH) or ".", exist_ok=True)
+    with open(OUT_PATH, "w") as f:
+        json.dump(result, f, indent=2)
+
+
+def summarize_trace(trace_dir: str, top_n: int = 25) -> dict:
+    """Top ops by summed duration from the Chrome-trace JSON(.gz) files
+    jax.profiler writes under <dir>/plugins/profile/<run>/."""
+    paths = (glob.glob(os.path.join(trace_dir, "**", "*.trace.json.gz"),
+                       recursive=True)
+             + glob.glob(os.path.join(trace_dir, "**", "*.trace.json"),
+                         recursive=True))
+    if not paths:
+        return {"error": f"no trace files under {trace_dir}"}
+    by_name: dict[str, float] = {}
+    pid_names: dict[int, str] = {}
+    total_events = 0
+    for path in paths:
+        opener = gzip.open if path.endswith(".gz") else open
+        with opener(path, "rt") as f:
+            data = json.load(f)
+        for ev in data.get("traceEvents", []):
+            if ev.get("ph") == "M" and ev.get("name") == "process_name":
+                pid_names[ev.get("pid")] = ev["args"].get("name", "")
+            if ev.get("ph") != "X" or "dur" not in ev:
+                continue
+            total_events += 1
+            name = ev.get("name", "?")[:120]
+            by_name[name] = by_name.get(name, 0.0) + ev["dur"]
+    top = sorted(by_name.items(), key=lambda kv: -kv[1])[:top_n]
+    return {
+        "trace_files": len(paths),
+        "events": total_events,
+        "tracks": sorted(set(pid_names.values())),
+        "top_ops_ms": [{"name": n, "total_ms": round(d / 1e3, 3)}
+                       for n, d in top],
+    }
+
+
+def run(result: dict) -> None:
+    problem_name = os.environ.get("PROFILE_PROBLEM", "inverted_pendulum")
+    eps_a = float(os.environ.get("PROFILE_EPS", "0.1"))
+    steps = int(os.environ.get("PROFILE_STEPS", "5"))
+    budget = float(os.environ.get("PROFILE_TIME_BUDGET", "600"))
+    trace_dir = os.environ.get("PROFILE_TRACE_DIR", "/tmp/jax_trace_profile")
+    platform = choose_backend(result)
+    on_acc = platform != "cpu"
+
+    from explicit_hybrid_mpc_tpu.config import PartitionConfig
+    from explicit_hybrid_mpc_tpu.oracle.oracle import Oracle
+    from explicit_hybrid_mpc_tpu.partition.frontier import build_partition
+    from explicit_hybrid_mpc_tpu.problems.registry import make
+
+    problem = make(problem_name)
+    oracle = Oracle(problem, backend="device" if on_acc else "cpu",
+                    precision="mixed", points_cap=2048 if on_acc else 256)
+    # Warm fully: the trace must show steady-state steps, not compiles.
+    warm_oracle(oracle, problem)
+    log_path = os.path.join(trace_dir, "steps.jsonl")
+    os.makedirs(trace_dir, exist_ok=True)
+    if os.path.exists(log_path):
+        os.remove(log_path)
+    cfg = PartitionConfig(problem=problem_name, eps_a=eps_a,
+                          backend="device", batch_simplices=512,
+                          max_steps=steps + 40, precision="mixed",
+                          time_budget_s=budget,
+                          profile_path=trace_dir, profile_steps=steps,
+                          log_path=log_path)
+    res = build_partition(problem, cfg, oracle=oracle)
+    result["problem"] = problem_name
+    result["eps_a"] = eps_a
+    result["profiled_steps"] = steps
+    result["build"] = {k: res.stats[k] for k in
+                       ("regions", "steps", "oracle_solves", "wall_s",
+                        "device_failures")}
+    step_rows = [json.loads(ln) for ln in open(log_path)
+                 if '"device_frac"' in ln]
+    result["device_frac"] = [r["device_frac"] for r in step_rows]
+    result["step_s"] = [r["step_s"] for r in step_rows]
+    _flush(result)
+    result["trace_dir"] = trace_dir
+    result["trace_summary"] = summarize_trace(trace_dir)
+
+
+def main() -> int:
+    result: dict = {"captured_at": time.strftime("%Y-%m-%d %H:%M:%S")}
+    try:
+        run(result)
+    except BaseException as e:
+        import traceback
+
+        result["error"] = repr(e)
+        traceback.print_exc(file=sys.stderr)
+    finally:
+        _flush(result)
+        print(json.dumps(result)[:2000])
+    return 0 if "error" not in result else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

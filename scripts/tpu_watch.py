@@ -31,15 +31,27 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 ART = os.path.join(REPO, "artifacts")
 
 # (artifact, script, env, timeout_s, platform_key)
+# Priority order = evidence value per chip-minute.  Budgets assume a
+# flaky tunnel: every script writes its artifact incrementally, so a
+# mid-capture hang (observed r3: a device call that never returns --
+# the per-capture subprocess timeout is the only recovery) loses only
+# the unfinished sections.
 CAPTURES = [
     ("north_star.json", "scripts/north_star.py",
-     {"NS_TIME_BUDGET": "900"}, 7200, ("flagship", "platform")),
+     {"NS_TIME_BUDGET": "2400", "NS_PARITY_EPS": "0.2"}, 9000,
+     ("flagship", "platform")),
+    ("tune_schedule.json", "scripts/tune_schedule.py",
+     {"TUNE_BUILD_BUDGET": "600"}, 3600, ("platform",)),
     ("bench_tpu.json", "bench.py", {"BENCH_OUT": "artifacts/bench_tpu.json"},
      1800, ("platform",)),
+    ("precision.json", "scripts/precision_check.py",
+     {"PREC_TIME_BUDGET": "1200"}, 5400, ("platform",)),
     ("configs.json", "scripts/bench_configs.py",
-     {"CONFIGS_TIME_BUDGET": "300"}, 5400, ("platform",)),
-    ("online_crossover.json", "scripts/online_crossover.py", {}, 5400,
+     {"CFG_TIME_BUDGET": "600"}, 7200, ("platform",)),
+    ("online_crossover.json", "scripts/online_crossover.py",
+     {"CROSS_EPS": "0.5,0.2,0.1,0.05,0.02,0.01,0.005"}, 7200,
      ("platform",)),
+    ("profile.json", "scripts/profile_capture.py", {}, 3600, ("platform",)),
 ]
 
 

@@ -134,6 +134,28 @@ def qp_solve(Q: jax.Array, q: jax.Array, A: jax.Array, b: jax.Array,
     dtype = Q.dtype
     reg = jnp.asarray(1e-10, dtype)
 
+    # -- Jacobi equilibration -------------------------------------------
+    # Penalty-weighted problems (quadrotor soft obstacle terms: diag(H)
+    # spans 0.32..1.7e7, cond(H) ~ 3e8) stall the fixed-iteration IPM --
+    # and make the f32 phase useless (cond >> 1/eps_f32), which starved
+    # the mixed schedule's short f64 polish (found r3: every quadrotor
+    # stage-2 Vmin came back -inf, so nothing ever certified).  Symmetric
+    # column scaling by sqrt(diag(Q)) + constraint row scaling fixes the
+    # diagonal disparity exactly; the objective value is invariant
+    # (z_s = Dz, Q_s = D^-1 Q D^-1), duals unscale as lam = lam_s / row,
+    # slacks as s = row * s_s.  Iterations run on the scaled data; the
+    # returned solution and the final KKT residuals are in ORIGINAL units.
+    dQ = jnp.diagonal(Q, axis1=-2, axis2=-1)
+    dcol = jnp.sqrt(jnp.maximum(dQ, jnp.max(dQ) * 1e-14 + _TINY))
+    Q_in, q_in, A_in, b_in = Q, q, A, b
+    Q = Q / dcol[:, None] / dcol[None, :]
+    q = q / dcol
+    A = A / dcol[None, :]
+    rown = jnp.max(jnp.abs(A), axis=-1)
+    rown = jnp.where(rown > 1e-10, rown, 1.0)  # all-zero padding rows
+    A = A / rown[:, None]
+    b = b / rown
+
     # Initial point: unconstrained minimizer, unit slacks/duals shifted to
     # cover the initial primal infeasibility (standard Mehrotra start).
     Lq = jnp.linalg.cholesky(Q + reg * jnp.eye(nz, dtype=dtype))
@@ -182,10 +204,17 @@ def qp_solve(Q: jax.Array, q: jax.Array, A: jax.Array, b: jax.Array,
     body = _make_body(Q, q, A, b)
     z, s, lam = jax.lax.fori_loop(0, n_iter, body, start)
 
-    r_p = jnp.max(jnp.abs(A @ z + s - b)) / scale_p
-    r_d = jnp.max(jnp.abs(Q @ z + q + A.T @ lam)) / scale_d
+    # Back to original units for the returned solution and the KKT
+    # residual checks (tolerances must mean what callers think they mean).
+    z = z / dcol
+    s = s * rown
+    lam = lam / rown
+    scale_p = 1.0 + jnp.max(jnp.abs(b_in))
+    scale_d = 1.0 + jnp.max(jnp.abs(q_in))
+    r_p = jnp.max(jnp.abs(A_in @ z + s - b_in)) / scale_p
+    r_d = jnp.max(jnp.abs(Q_in @ z + q_in + A_in.T @ lam)) / scale_d
     gap = jnp.dot(s, lam) / nc / scale_d
-    obj = 0.5 * z @ Q @ z + q @ z
+    obj = 0.5 * z @ Q_in @ z + q_in @ z
     # Infeasible problems diverge (lam blows up; residuals may go NaN/inf) --
     # any non-finite iterate is classified not-converged, not-feasible.
     finite = (jnp.all(jnp.isfinite(z)) & jnp.isfinite(r_p) & jnp.isfinite(r_d)

@@ -12,7 +12,8 @@ device execution:
   fixed-commutation QP for EVERY commutation (enumeration replaces
   branch-and-bound) and reduce to V*(theta), delta*(theta).  One vmapped
   IPM call over (points x commutations).
-- `solve_simplex_min(simplices, delta_idx)` -- exact min of V_delta over a
+- `solve_simplex_min(simplices, delta_idx)` -- certified lower bound on
+  min V_delta over a
   simplex via the joint QP in (z, theta), used by the eps-certificate when
   vertex tangent bounds are unavailable (see partition/certificates.py).
 - `simplex_feasibility(simplices, delta_idx)` / `feasibility(thetas,
@@ -178,30 +179,70 @@ def _simplex_feas_one(prob: DeviceProblem, bary_M: jax.Array, d: int,
 
 
 def _solve_simplex_min_one(prob: DeviceProblem, bary_M: jax.Array,
-                           d: int, n_iter: int, n_f32: int = 0):
-    """Exact min_{theta in R} V_delta(theta): joint QP over (z, theta).
+                           d: int, n_iter: int, n_f32: int = 0,
+                           rho_elastic: float = 1e4):
+    """Lower bound on min_{theta in R} V_delta(theta): ELASTIC joint QP
+    over (z, theta, t).
 
     bary_M is the (p+1, p+1) barycentric matrix of the simplex (lambda =
     bary_M @ [theta;1]); theta-in-simplex is lambda >= 0.  The joint
     Hessian [[H, F],[F', Y]] is PSD by construction (it is the original
-    stage-cost quadratic); a small ridge on the theta block keeps the IPM's
-    Cholesky PD.
+    stage-cost quadratic); a small ridge on the theta block keeps the
+    IPM's Cholesky PD.
+
+    The scalar elastic t >= 0 relaxes the problem rows (NOT the simplex
+    rows -- theta must stay in R) with an exact linear penalty rho_e*t:
+    the relaxation only ENLARGES the feasible set, so the optimum is a
+    valid lower bound on the true simplex minimum (sound for the
+    certificate), it is EXACT whenever rho_e exceeds the active duals'
+    l1 norm (standard exact-penalty bound), and -- the reason it exists
+    -- the elastic problem always has a strict interior, so the
+    interior-point kernel cannot stall on commutations whose hard
+    integer-encoding rows are infeasible or interior-free on the simplex
+    (found r3: every quadrotor stage-2 bound came back unusable and
+    nothing ever certified).
     """
     nz = prob.H.shape[1]
     nt = prob.Y.shape[1]
     dtype = prob.H.dtype
     ridge = 1e-9
-    Hj = jnp.block([[prob.H[d], prob.F[d]],
-                    [prob.F[d].T, prob.Y[d] + ridge * jnp.eye(nt, dtype=dtype)]])
-    qj = jnp.concatenate([prob.f[d], prob.pvec[d]])
-    # Gz - S theta <= w  and  -M_theta theta <= m_c (simplex membership).
+    nb = bary_M.shape[0]
+    nc = prob.G.shape[1]
+    Hj = jnp.block([
+        [prob.H[d], prob.F[d], jnp.zeros((nz, 1), dtype=dtype)],
+        [prob.F[d].T, prob.Y[d] + ridge * jnp.eye(nt, dtype=dtype),
+         jnp.zeros((nt, 1), dtype=dtype)],
+        [jnp.zeros((1, nz + nt), dtype=dtype),
+         jnp.full((1, 1), 1e-2, dtype=dtype)]])
+    qj = jnp.concatenate([prob.f[d], prob.pvec[d],
+                          jnp.full((1,), rho_elastic, dtype=dtype)])
+    # Gz - S theta - t <= w;  -M_theta theta <= m_c (hard);  -t <= 0.
     M_th = bary_M[:, :nt]
     m_c = bary_M[:, nt]
-    Gj = jnp.block([[prob.G[d], -prob.S[d]],
-                    [jnp.zeros((M_th.shape[0], nz), dtype=dtype), -M_th]])
-    bj = jnp.concatenate([prob.w[d], m_c])
-    sol = ipm.qp_solve(Hj, qj, Gj, bj, n_iter=n_iter, n_f32=n_f32)
-    return sol.obj + prob.cconst[d], sol.converged, sol.feasible
+    Gj = jnp.block([
+        [prob.G[d], -prob.S[d], -jnp.ones((nc, 1), dtype=dtype)],
+        [jnp.zeros((nb, nz), dtype=dtype), -M_th,
+         jnp.zeros((nb, 1), dtype=dtype)],
+        [jnp.zeros((1, nz + nt), dtype=dtype),
+         -jnp.ones((1, 1), dtype=dtype)]])
+    bj = jnp.concatenate([prob.w[d], m_c, jnp.zeros(1, dtype=dtype)])
+    # tol: qp_solve's convergence test is RELATIVE to scale_d ~ 1+max|q|,
+    # and the rho_elastic entry inflates that to ~rho -- at tol=1e-8 a
+    # "converged" elastic value could be off by ~rho*1e-8 ABSOLUTE, which
+    # at rho=1e6 was comparable to eps_a=1e-2 certification tolerances
+    # (code-review r3).  rho=1e4 + tol=1e-9 keeps the absolute value
+    # error ~1e-5, far below every config's eps.
+    sol = ipm.qp_solve(Hj, qj, Gj, bj, n_iter=n_iter, n_f32=n_f32,
+                       tol=1e-9)
+    # Clamp: the -t <= 0 row is only honored to the primal tolerance, and
+    # a slightly NEGATIVE t would ADD rho*|t| to the reported bound --
+    # the unsound direction for a lower bound.  Clamped, any solver error
+    # only loosens the bound (safe).
+    t_elastic = jnp.maximum(sol.z[nz + nt], 0.0)
+    # Drop the penalty term from the reported bound: value + rho*t >= value,
+    # and value alone is the (possibly looser) valid lower bound.
+    obj = sol.obj - rho_elastic * t_elastic - 0.5e-2 * t_elastic ** 2
+    return obj + prob.cconst[d], sol.converged, sol.feasible
 
 
 class Oracle:
@@ -245,6 +286,20 @@ class Oracle:
         if n_f32 is not None and not 0 <= n_f32 <= n_iter:
             raise ValueError(f"n_f32={n_f32} must lie in [0, n_iter="
                              f"{n_iter}] (the rest is the f64 polish)")
+        # Conditioning gate for the mixed schedule: on problems whose
+        # EQUILIBRATED Hessians are still ill-conditioned (quadrotor:
+        # cond 3e8 raw / 6e5 scaled, from condensing an unstable 12-state
+        # plant over N=10), the f32 phase never passes the f64 merit gate
+        # and the short polish then starts cold and stalls -- every
+        # stage-2 Vmin came back unusable and nothing ever certified
+        # (found r3).  Measured once per problem on host; > 1e4 falls
+        # back to the full-length f64 schedule.  An explicit n_f32
+        # override skips the gate (tuning scripts own the risk).
+        self.hessian_cond_scaled = None  # computed only when the gate runs
+        if precision == "mixed" and n_f32 is None:
+            self.hessian_cond_scaled = self._scaled_cond(self.can.H)
+            if self.hessian_cond_scaled > 1e4:
+                n_f32 = 0
         self.n_f32 = ((2 * n_iter) // 3 if n_f32 is None else n_f32) \
             if precision == "mixed" else 0
         self.n_iter = n_iter - self.n_f32
@@ -304,6 +359,19 @@ class Oracle:
             jax.vmap(lambda th, d: _solve_one(
                 self.prob, th, d, self.n_iter, self.n_f32),
                 in_axes=(0, 0)))
+
+    @staticmethod
+    def _scaled_cond(H: np.ndarray) -> float:
+        """Worst condition number over commutations of the Jacobi-scaled
+        Hessians -- what the IPM actually iterates on after the kernel's
+        equilibration (ipm.qp_solve)."""
+        worst = 1.0
+        for d in range(H.shape[0]):
+            dg = np.diag(H[d])
+            dc = np.sqrt(np.maximum(dg, max(dg.max(), 1e-300) * 1e-14))
+            ev = np.linalg.eigvalsh(H[d] / dc[:, None] / dc[None, :])
+            worst = max(worst, ev[-1] / max(ev[0], 1e-300))
+        return float(worst)
 
     # -- the MICP-at-a-point query (reference: P_theta) --------------------
 
@@ -400,7 +468,10 @@ class Oracle:
         """min_{theta in R} V_delta(theta) for a batch of (simplex, delta).
 
         Returns (Vmin, feasible_somewhere).  Encoding of Vmin:
-        - finite: exact simplex minimum (min-QP converged);
+        - finite: certified LOWER BOUND on the simplex minimum from the
+                  elastic joint QP -- exact when the elastic slack is 0
+                  (the strictly-feasible case), strictly below the true
+                  minimum otherwise (sound either way);
         - +inf:   POSITIVE evidence of infeasibility on all of R (the
                   always-strictly-feasible joint phase-1 converged with
                   violation t* > tol) -- excludable from the V* lower bound;

@@ -19,7 +19,8 @@ L  (lower bound on the optimal cost V* = min_delta' V_delta'):
    V_delta' = +inf, so the bound holds trivially).  Hence
        V*(theta) >= min_delta' max_i l_{delta',i}(theta).
    For a delta' converged at NO vertex, the engine asks the oracle for the
-   exact simplex minimum min_{theta in R} V_delta'(theta) (a joint QP over
+   certified lower bound on min_{theta in R} V_delta'(theta) (an elastic
+   joint QP over
    (z, theta)), a constant valid lower bound on R -- or a proof that delta'
    is infeasible on all of R, excluding it from the min.
 
@@ -174,7 +175,8 @@ def certify_suboptimal_stage2(sd: SimplexVertexData, res: CertificateResult,
                               eps_r: float) -> CertificateResult:
     """Complete a 'pending' certification with stage-2 simplex minima.
 
-    Vmin maps pending delta' -> exact min of V_delta' over R (+inf if delta'
+    Vmin maps pending delta' -> certified lower bound on V_delta' over R
+    (exact when the elastic slack is zero; +inf if delta'
     infeasible on all of R; -inf if the joint solve failed, blocking
     certification conservatively).
     """

@@ -120,7 +120,7 @@ class FrontierEngine:
         # ancestors.  +inf = Farkas-certified infeasible on an ancestor
         # simplex -- exact for every descendant (child subset of ancestor),
         # so the (node, delta) stage-2 solve is skipped forever.  A finite
-        # value is the ancestor's exact simplex minimum: a valid (but
+        # value is the ancestor's certified simplex lower bound: a valid (but
         # possibly loose) lower bound on any child; it is used to attempt
         # certification for free, and re-solved exactly only when the
         # loose-bound certificate fails (round B below) -- which keeps the
@@ -252,7 +252,7 @@ class FrontierEngine:
             return bary_memo[n]
 
         # Exact per-delta facts established THIS step (Farkas +inf
-        # exclusions, exact simplex minima) -- inherited by children when
+        # exclusions, certified simplex lower bounds) -- inherited by children when
         # the node splits.
         fresh: dict[int, dict[int, float]] = collections.defaultdict(dict)
         for n in nodes:
@@ -308,7 +308,7 @@ class FrontierEngine:
         if stage2:
             # Round A: solve only (node, delta') pairs with NO inherited
             # bound.  +inf entries are exact ancestor Farkas exclusions;
-            # finite entries are ancestor simplex minima -- valid lower
+            # finite entries are ancestor simplex lower bounds -- valid lower
             # bounds on any child, tried for free first.
             solve_list: list[tuple[int, int]] = []
             vm_map: dict[int, dict[int, float]] = collections.defaultdict(dict)

@@ -47,6 +47,14 @@ CONFIGS = [
      {"axes": 3}, 1.0, 0.1),
     ("5. quadrotor obstacle avoidance (4-D pv, 16 deltas)", "quadrotor",
      {"param": "pv"}, 1.0, 0.1),
+    # Demonstration rows: benchmark-size 6-D/4-D boxes need cluster-scale
+    # compute to certify ANY volume (measured onset scales r3: satellite
+    # ~12% box => ~1e8 regions; quadrotor ~2-5% box).  These rows prove
+    # the same problem families certify end-to-end at tractable scale.
+    ("4b. satellite z-axis slice (2s, 3 deltas)", "satellite",
+     {"axes": 1}, 1e-2, 0.0),
+    ("5b. quadrotor pv sub-box (25% box, 16 deltas)", "quadrotor",
+     {"param": "pv", "pos_box": 1.0, "vel_box": 0.5}, 1.0, 0.1),
 ]
 
 

@@ -43,6 +43,12 @@ from explicit_hybrid_mpc_tpu.problems.base import CanonicalMPQP
 
 _INF = np.inf
 
+# Quadratic weight on the elastic slack of the stage-2 simplex bound --
+# used BOTH in the Hessian block and in the bound's penalty subtraction
+# (_solve_simplex_min_one); the two must stay equal or the reported
+# "lower bound" silently retains un-subtracted penalty (unsound).
+_ELASTIC_QUAD = 1e-2
+
 
 class DeviceProblem(NamedTuple):
     """CanonicalMPQP staged as jnp arrays (one slice per commutation)."""
@@ -57,6 +63,8 @@ class DeviceProblem(NamedTuple):
     pvec: jax.Array
     cconst: jax.Array
     u_map: jax.Array
+    u_theta: jax.Array
+    u_const: jax.Array
 
 
 def to_device(can: CanonicalMPQP) -> DeviceProblem:
@@ -91,7 +99,9 @@ def _solve_one(prob: DeviceProblem, theta: jax.Array, d: int, n_iter: int,
     # Envelope theorem: dV/dtheta = F'z* + Y theta + p - S'lam*.
     grad = (prob.F[d].T @ sol.z + prob.Y[d] @ theta + prob.pvec[d]
             - prob.S[d].T @ sol.lam)
-    u0 = prob.u_map[d] @ sol.z
+    # Affine theta part is nonzero only under prestabilized condensing
+    # (z holds v; the applied input is u = K x(theta) + v).
+    u0 = prob.u_map[d] @ sol.z + prob.u_theta[d] @ theta + prob.u_const[d]
     return V, sol.converged, grad, u0, sol.z
 
 
@@ -213,7 +223,7 @@ def _solve_simplex_min_one(prob: DeviceProblem, bary_M: jax.Array,
         [prob.F[d].T, prob.Y[d] + ridge * jnp.eye(nt, dtype=dtype),
          jnp.zeros((nt, 1), dtype=dtype)],
         [jnp.zeros((1, nz + nt), dtype=dtype),
-         jnp.full((1, 1), 1e-2, dtype=dtype)]])
+         jnp.full((1, 1), _ELASTIC_QUAD, dtype=dtype)]])
     qj = jnp.concatenate([prob.f[d], prob.pvec[d],
                           jnp.full((1,), rho_elastic, dtype=dtype)])
     # Gz - S theta - t <= w;  -M_theta theta <= m_c (hard);  -t <= 0.
@@ -241,7 +251,8 @@ def _solve_simplex_min_one(prob: DeviceProblem, bary_M: jax.Array,
     t_elastic = jnp.maximum(sol.z[nz + nt], 0.0)
     # Drop the penalty term from the reported bound: value + rho*t >= value,
     # and value alone is the (possibly looser) valid lower bound.
-    obj = sol.obj - rho_elastic * t_elastic - 0.5e-2 * t_elastic ** 2
+    obj = (sol.obj - rho_elastic * t_elastic
+           - 0.5 * _ELASTIC_QUAD * t_elastic ** 2)
     return obj + prob.cconst[d], sol.converged, sol.feasible
 
 

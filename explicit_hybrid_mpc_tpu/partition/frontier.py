@@ -122,10 +122,15 @@ class FrontierEngine:
         # so the (node, delta) stage-2 solve is skipped forever.  A finite
         # value is the ancestor's certified simplex lower bound: a valid (but
         # possibly loose) lower bound on any child; it is used to attempt
-        # certification for free, and re-solved exactly only when the
-        # loose-bound certificate fails (round B below) -- which keeps the
-        # split/certify DECISIONS identical to an inheritance-free build
-        # (region-count parity by construction; tests/test_partition.py).
+        # certification for free, and re-solved on the child's own simplex
+        # only when the loose-bound certificate fails (round B below).
+        # CERTIFIED decisions then match an inheritance-free build; the
+        # builds are NOT tree-identical, because an inherited +inf is
+        # strictly more accurate than re-running the child's phase-1 (a
+        # stalled child solve demotes an exactly-known infeasible simplex
+        # to 'split'), so the uninherited build may subdivide infeasible
+        # space slightly further (tests/test_partition.py asserts the
+        # guaranteed direction + identical certified volume).
         # BENCH_r02 measured 82% of all solves in stage-2 joint QPs,
         # mostly re-proving the same delta' infeasible down entire
         # subtrees; this inheritance removes that re-proving.

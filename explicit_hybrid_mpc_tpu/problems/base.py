@@ -48,7 +48,12 @@ class CanonicalMPQP:
     Y: np.ndarray      # (nd, n_theta, n_theta) theta-quadratic cost term
     pvec: np.ndarray   # (nd, n_theta)  theta-linear cost term
     cconst: np.ndarray  # (nd,) constant cost term
-    u_map: np.ndarray  # (nd, n_u, nz): first control move u0 = u_map[d] @ z
+    # First applied control move: u0 = u_map[d] @ z + u_theta[d] @ theta
+    # + u_const[d].  The affine theta part is nonzero only under
+    # prestabilized condensing (z holds v, u = K x + v).
+    u_map: np.ndarray  # (nd, n_u, nz)
+    u_theta: np.ndarray  # (nd, n_u, n_theta)
+    u_const: np.ndarray  # (nd, n_u)
     deltas: np.ndarray  # (nd, m) integer encodings, for reporting/tie-breaks
 
     @property
@@ -94,6 +99,9 @@ class CondensedSlice:
     pvec: np.ndarray
     cconst: float
     u_map: np.ndarray
+    # Affine-in-theta part of u0 (prestabilized condensing; zero otherwise).
+    u_theta: np.ndarray | None = None
+    u_const: np.ndarray | None = None
 
 
 def condense(
@@ -110,6 +118,7 @@ def condense(
     input_con: Optional[Sequence[tuple[np.ndarray, np.ndarray]]] = None,
     theta_con: Optional[tuple[np.ndarray, np.ndarray]] = None,
     u_selector: Optional[np.ndarray] = None,
+    K_prestab: Optional[np.ndarray] = None,
 ) -> CondensedSlice:
     """Condense one fixed-commutation linear MPC into an mp-QP slice.
 
@@ -131,7 +140,21 @@ def condense(
     so that value functions are comparable ACROSS commutations (required by
     the eps-suboptimality certificates, SURVEY.md section 8 "certificate
     math").
+
+    K_prestab: optional (m, n_x) feedback gain for CLOSED-LOOP condensing
+    (u_k = K x_k + v_k; the decision vector becomes v).  An EXACT variable
+    substitution -- same value function, same applied inputs -- whose
+    point is conditioning: condensing an unstable plant open-loop grows
+    H entries with powers of A (quadrotor: cond(H) ~ 3e8), while the
+    prestabilized A + BK keeps H near the weight scale so the f32-bulk
+    mixed IPM schedule stays usable on TPU.  Constraint row ORDER matches
+    the open-loop path exactly (soften() indexes rows by position).
     """
+    if K_prestab is not None:
+        return _condense_prestab(
+            A_seq, B_seq, e_seq, Q, R, P, E, x_nom, n_u,
+            np.asarray(K_prestab, dtype=np.float64),
+            state_con, input_con, theta_con, u_selector)
     N = len(A_seq)
     n_x = A_seq[0].shape[0]
     m = B_seq[0].shape[1]
@@ -219,6 +242,125 @@ def condense(
                           cconst=cconst, u_map=u_map)
 
 
+def _condense_prestab(A_seq, B_seq, e_seq, Q, R, P, E, x_nom, n_u, K,
+                      state_con, input_con, theta_con,
+                      u_selector) -> CondensedSlice:
+    """Closed-loop condensing: substitute u_k = K x_k + v_k and condense
+    in v.  Derivation (stage cost with the substitution):
+
+        1/2 x'Qx + 1/2 u'Ru = 1/2 x'(Q + K'RK)x + x'K'R v + 1/2 v'Rv
+
+    so with X0 = [x_0..x_{N-1}] (affine in (x0, v) through the CLOSED-
+    LOOP prediction matrices) the objective is quadratic in v with a
+    cross term X0' blkdiag(K'R) v; x_N carries the terminal P.  Exactness
+    is tested against the open-loop path (tests/test_problems.py)."""
+    N = len(A_seq)
+    n_x = A_seq[0].shape[0]
+    m = B_seq[0].shape[1]
+    nz = N * m
+    E = np.asarray(E, dtype=np.float64)
+    n_theta = E.shape[1]
+    x_nom = np.asarray(x_nom, dtype=np.float64)
+
+    Acl = [np.asarray(A_seq[k]) + np.asarray(B_seq[k]) @ K
+           for k in range(N)]
+    # Closed-loop prediction: X = Phi x0 + Gam v + phi, X = [x_1..x_N].
+    Phi = np.zeros((N * n_x, n_x))
+    Gam = np.zeros((N * n_x, nz))
+    phi = np.zeros(N * n_x)
+    for k in range(N):
+        rows = slice(k * n_x, (k + 1) * n_x)
+        if k == 0:
+            Phi[rows] = Acl[0]
+            phi[rows] = e_seq[0]
+        else:
+            prev = slice((k - 1) * n_x, k * n_x)
+            Phi[rows] = Acl[k] @ Phi[prev]
+            phi[rows] = Acl[k] @ phi[prev] + e_seq[k]
+            Gam[rows] = Acl[k] @ Gam[prev]
+        Gam[rows, k * m:(k + 1) * m] = B_seq[k]
+
+    # X0 = [x_0..x_{N-1}] map (x_0 is affine in theta, not part of X).
+    Phi0 = np.vstack([np.eye(n_x), Phi[:(N - 1) * n_x]])
+    Gam0 = np.vstack([np.zeros((n_x, nz)), Gam[:(N - 1) * n_x]])
+    phi0 = np.concatenate([np.zeros(n_x), phi[:(N - 1) * n_x]])
+    PhiN = Phi[(N - 1) * n_x:]
+    GamN = Gam[(N - 1) * n_x:]
+    phiN = phi[(N - 1) * n_x:]
+
+    Qk = Q + K.T @ R @ K
+    Qt = np.kron(np.eye(N), Qk)
+    Cross = np.kron(np.eye(N), K.T @ R)      # (N n_x, N m)
+    Rbar = np.kron(np.eye(N), R)
+
+    H = (Gam0.T @ Qt @ Gam0 + Gam0.T @ Cross + Cross.T @ Gam0 + Rbar
+         + GamN.T @ P @ GamN)
+    H = 0.5 * (H + H.T)
+    Fx0 = Gam0.T @ Qt @ Phi0 + Cross.T @ Phi0 + GamN.T @ P @ PhiN
+    F = Fx0 @ E
+    f = (Fx0 @ x_nom + Gam0.T @ Qt @ phi0 + Cross.T @ phi0
+         + GamN.T @ P @ phiN)
+
+    Q0 = Phi0.T @ Qt @ Phi0 + PhiN.T @ P @ PhiN
+    g0 = Phi0.T @ Qt @ phi0 + PhiN.T @ P @ phiN
+    Y = E.T @ Q0 @ E
+    Y = 0.5 * (Y + Y.T)
+    pvec = E.T @ (Q0 @ x_nom + g0)
+    cconst = float(0.5 * x_nom @ Q0 @ x_nom + x_nom @ g0
+                   + 0.5 * phi0 @ Qt @ phi0 + 0.5 * phiN @ P @ phiN)
+
+    # Constraints -- SAME row order as the open-loop path.
+    G_rows, w_rows, S_rows = [], [], []
+    if state_con is not None:
+        for k, con in enumerate(state_con):
+            if con is None:
+                continue
+            Cx, cx = con
+            rows = slice(k * n_x, (k + 1) * n_x)
+            G_rows.append(Cx @ Gam[rows])
+            w_rows.append(cx - Cx @ (Phi[rows] @ x_nom + phi[rows]))
+            S_rows.append(-Cx @ Phi[rows] @ E)
+    if input_con is not None:
+        for k, con in enumerate(input_con):
+            if con is None:
+                continue
+            Cu, cu = con
+            # u_k = K x_k + v_k with x_k affine in (x0, v).
+            if k == 0:
+                xk_Phi, xk_Gam, xk_phi = (np.eye(n_x),
+                                          np.zeros((n_x, nz)),
+                                          np.zeros(n_x))
+            else:
+                rs = slice((k - 1) * n_x, k * n_x)
+                xk_Phi, xk_Gam, xk_phi = Phi[rs], Gam[rs], phi[rs]
+            CuK = Cu @ K
+            Gk = CuK @ xk_Gam
+            Gk[:, k * m:(k + 1) * m] += Cu
+            G_rows.append(Gk)
+            w_rows.append(np.asarray(cu, dtype=np.float64)
+                          - CuK @ (xk_Phi @ x_nom + xk_phi))
+            S_rows.append(-CuK @ xk_Phi @ E)
+    if theta_con is not None:
+        Ct, ct = theta_con
+        G_rows.append(np.zeros((Ct.shape[0], nz)))
+        w_rows.append(np.asarray(ct, dtype=np.float64))
+        S_rows.append(-np.asarray(Ct, dtype=np.float64))
+
+    G = np.vstack(G_rows) if G_rows else np.zeros((0, nz))
+    w = np.concatenate(w_rows) if w_rows else np.zeros(0)
+    S = np.vstack(S_rows) if S_rows else np.zeros((0, n_theta))
+
+    sel = np.eye(n_u, m) if u_selector is None else np.asarray(u_selector)
+    if sel.shape != (n_u, m):
+        raise ValueError(f"u_selector must be ({n_u}, {m}), got {sel.shape}")
+    u_map = np.zeros((n_u, nz))
+    u_map[:, :m] = sel
+    selK = sel @ K
+    return CondensedSlice(H=H, f=f, F=F, G=G, w=w, S=S, Y=Y, pvec=pvec,
+                          cconst=cconst, u_map=u_map,
+                          u_theta=selK @ E, u_const=selK @ x_nom)
+
+
 def soften(sl: CondensedSlice, rows: np.ndarray,
            rho: float = 1e3) -> CondensedSlice:
     """Soften the given constraint rows with quadratic-penalty slacks.
@@ -248,7 +390,8 @@ def soften(sl: CondensedSlice, rows: np.ndarray,
     S = np.vstack([sl.S, np.zeros((ns, nt))])
     u_map = np.hstack([sl.u_map, np.zeros((sl.u_map.shape[0], ns))])
     return CondensedSlice(H=H, f=f, F=F, G=G, w=w, S=S, Y=sl.Y,
-                          pvec=sl.pvec, cconst=sl.cconst, u_map=u_map)
+                          pvec=sl.pvec, cconst=sl.cconst, u_map=u_map,
+                          u_theta=sl.u_theta, u_const=sl.u_const)
 
 
 def stack_slices(slices: Sequence[CondensedSlice],
@@ -271,6 +414,7 @@ def stack_slices(slices: Sequence[CondensedSlice],
         return G, w, S
 
     padded = [pad(s) for s in slices]
+    n_u = slices[0].u_map.shape[0]
     return CanonicalMPQP(
         H=np.stack([s.H for s in slices]),
         f=np.stack([s.f for s in slices]),
@@ -282,6 +426,10 @@ def stack_slices(slices: Sequence[CondensedSlice],
         pvec=np.stack([s.pvec for s in slices]),
         cconst=np.array([s.cconst for s in slices]),
         u_map=np.stack([s.u_map for s in slices]),
+        u_theta=np.stack([s.u_theta if s.u_theta is not None
+                          else np.zeros((n_u, n_theta)) for s in slices]),
+        u_const=np.stack([s.u_const if s.u_const is not None
+                          else np.zeros(n_u) for s in slices]),
         deltas=np.asarray(deltas),
     )
 

@@ -174,6 +174,19 @@ class Quadrotor(base.HybridMPC):
             np.array([-self.dT_max] + [-self.tau_max] * 3),
             np.array([self.dT_max] + [self.tau_max] * 3))
 
+        # Prestabilizing LQR gain: condensing the (unstable) 12-state
+        # linearization open-loop over N=10 grows H entries with powers
+        # of A (cond(H) ~ 3e8 -- stalls fixed-iteration IPMs and makes
+        # the f32 phase of the mixed schedule useless); condensing the
+        # closed loop u = Kx + v keeps H near the weight scale.  Exact
+        # substitution: same value function and applied inputs
+        # (tests/test_problems.py equivalence test).  K_pre is derived
+        # from the SAME DARE solution P used as the terminal cost above
+        # -- that pairing is load-bearing: it is the completion-of-
+        # squares identity that makes the condensed Hessian essentially
+        # diagonal (scaled cond ~1.0, docs/perf.md).
+        K_pre = -np.linalg.solve(R + Bd.T @ P @ Bd, Bd.T @ P @ Ad)
+
         slices, deltas = [], []
         for f0 in range(4):
             for f1 in range(4):
@@ -196,7 +209,7 @@ class Quadrotor(base.HybridMPC):
                     e_seq=[np.zeros(12)] * N,
                     Q=Q, R=R, P=P, E=E, x_nom=np.zeros(12), n_u=4,
                     state_con=[(Call, call)] * N,
-                    input_con=[(Cu, cu)] * N)
+                    input_con=[(Cu, cu)] * N, K_prestab=K_pre)
                 # Obstacle rows are the trailing 2 rows of each step's
                 # 26-row state block.  Hard avoidance makes the feasible
                 # set's boundary a dynamics-dependent surface slightly off

@@ -72,6 +72,77 @@ def test_condense_constraints_match_rollout(rng):
         assert can_ok == roll_ok
 
 
+def test_prestab_condense_is_exact_substitution(rng):
+    """Closed-loop condensing (u = Kx + v) is an exact reparametrization:
+    the cost of any input SEQUENCE agrees when expressed in v (J_v(v) =
+    J_u(u) with u_k = K x_k + v_k along the closed-loop trajectory),
+    constraint satisfaction agrees row-for-row, and the SOLVED problems
+    (via the oracle IPM) give the same value function and applied u0."""
+    n, m, N = 3, 2, 4
+    A = rng.normal(size=(n, n)) * 0.5 + np.eye(n)  # mildly unstable
+    B = rng.normal(size=(n, m))
+    Q, R, P = np.eye(n), np.eye(m) * 0.5, np.eye(n) * 2.0
+    K = -0.3 * np.linalg.pinv(B) @ (A - 0.5 * np.eye(n))
+    Cx, cx = base.box_rows(-4 * np.ones(n), 4 * np.ones(n))
+    Cu, cu = base.box_rows(-3 * np.ones(m), 3 * np.ones(m))
+    kw = dict(A_seq=[A] * N, B_seq=[B] * N, e_seq=[np.zeros(n)] * N,
+              Q=Q, R=R, P=P, E=np.eye(n), x_nom=np.zeros(n), n_u=m,
+              state_con=[(Cx, cx)] * N, input_con=[(Cu, cu)] * N)
+    ol = base.condense(**kw)
+    cl = base.condense(**kw, K_prestab=K)
+    assert cl.u_theta is not None and cl.u_const is not None
+
+    for _ in range(10):
+        theta = rng.uniform(-1, 1, size=n)
+        v = rng.uniform(-0.5, 0.5, size=N * m)
+        # Roll the closed loop to recover the u sequence v encodes.
+        x = theta.copy()
+        us = []
+        for k in range(N):
+            u = K @ x + v[k * m:(k + 1) * m]
+            us.append(u)
+            x = A @ x + B @ u
+        z = np.concatenate(us)
+        J_v = (0.5 * v @ cl.H @ v + (cl.f + cl.F @ theta) @ v
+               + 0.5 * theta @ cl.Y @ theta + cl.pvec @ theta + cl.cconst)
+        J_u = (0.5 * z @ ol.H @ z + (ol.f + ol.F @ theta) @ z
+               + 0.5 * theta @ ol.Y @ theta + ol.pvec @ theta + ol.cconst)
+        assert np.isclose(J_v, J_u, rtol=1e-9, atol=1e-9)
+        # Same rows, same satisfaction margins.
+        res_v = cl.G @ v - cl.w - cl.S @ theta
+        res_u = ol.G @ z - ol.w - ol.S @ theta
+        np.testing.assert_allclose(res_v, res_u, atol=1e-9)
+        # u0 reconstruction through the affine map.
+        u0_v = cl.u_map @ v + cl.u_theta @ theta + cl.u_const
+        np.testing.assert_allclose(u0_v, us[0], atol=1e-12)
+
+    # Solved problems agree: same V*(theta) and same applied u0.
+    from explicit_hybrid_mpc_tpu.oracle.oracle import Oracle
+
+    class _Wrap(base.HybridMPC):
+        name = "_prestab_wrap"
+
+        def __init__(self, sl):
+            self._sl = sl
+            self.theta_lb = -np.ones(n)
+            self.theta_ub = np.ones(n)
+            self.n_u = m
+
+        def build_canonical(self):
+            return base.stack_slices([self._sl],
+                                     deltas=np.zeros((1, 0), np.int64))
+
+    o_ol = Oracle(_Wrap(ol), backend="cpu")
+    o_cl = Oracle(_Wrap(cl), backend="cpu")
+    thetas = rng.uniform(-0.8, 0.8, size=(8, n))
+    s_ol = o_ol.solve_vertices(thetas)
+    s_cl = o_cl.solve_vertices(thetas)
+    assert s_ol.conv.all() and s_cl.conv.all()
+    np.testing.assert_allclose(s_cl.Vstar, s_ol.Vstar, rtol=1e-6, atol=1e-8)
+    np.testing.assert_allclose(s_cl.u0[:, 0], s_ol.u0[:, 0],
+                               rtol=1e-5, atol=1e-7)
+
+
 def test_canonical_problems_wellformed():
     for name in names():
         prob = make(name)

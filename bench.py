@@ -163,15 +163,32 @@ def warm_oracle(oracle, problem, stop_after: float | None = None) -> None:
         retry_transient(lambda: oracle.solve_vertices(pts),
                         what=f"warmup bucket {b}")
         b *= 2
-    # Simplex-query buckets (solve_simplex_min warms both the min-QP and
-    # the phase-1 program; simplex_feasibility reuses the latter).
+    # Sparse (point, delta) pair buckets -- the masked-vertex path
+    # (frontier._solve_missing skips ancestor-excluded commutations).
+    nd = problem.canonical.n_delta
+    if nd > 1:
+        b = 8
+        while b <= oracle.max_pairs_per_call:
+            if stop_after is not None and time.time() > stop_after:
+                log(f"warmup stopped early at pair bucket {b}")
+                break
+            log(f"warmup: pair bucket {b}")
+            pts = rng.uniform(problem.theta_lb, problem.theta_ub,
+                              size=(b, problem.n_theta))
+            ds = (np.arange(b, dtype=np.int64) % nd)
+            retry_transient(lambda: oracle.solve_pairs(pts, ds),
+                            what=f"pair warmup {b}")
+            b *= 2
+    # Simplex-query buckets.  solve_simplex_min warms the min-QP program;
+    # its phase-1 pass now runs only on suspect subsets, so the phase-1
+    # program is warmed explicitly via simplex_feasibility at every
+    # bucket (an unwarmed bucket is a ~minute mid-run tunnel compile).
     from explicit_hybrid_mpc_tpu.partition import geometry
 
     span = problem.theta_ub - problem.theta_lb
     V0 = np.vstack([problem.theta_lb,
                     problem.theta_lb + 0.1 * np.diag(span)])
     M1 = geometry.barycentric_matrix(V0)
-    nd = problem.canonical.n_delta
     b = 8
     while b <= oracle.max_simplex_rows_per_call:
         if stop_after is not None and time.time() > stop_after:
@@ -182,6 +199,8 @@ def warm_oracle(oracle, problem, stop_after: float | None = None) -> None:
         ds = (np.arange(b, dtype=np.int64) % nd)
         retry_transient(lambda: oracle.solve_simplex_min(Ms, ds),
                         what=f"simplex warmup {b}")
+        retry_transient(lambda: oracle.simplex_feasibility(Ms, ds),
+                        what=f"phase-1 warmup {b}")
         b *= 2
 
 

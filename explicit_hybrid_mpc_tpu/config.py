@@ -75,6 +75,15 @@ class PartitionConfig:
     # build (frontier.py step(); tests/test_partition.py); False exists for
     # that parity test and for debugging.
     inherit_bounds: bool = True
+    # Skip point solves for commutations Farkas-excluded on an ancestor
+    # simplex (every vertex of a child lies inside the ancestor, so the
+    # excluded commutation's point QP is infeasible by certificate --
+    # solving it is pure waste; deep subdivision tails spend most of their
+    # point-solve work there).  Requires inherit_bounds; single-device
+    # oracles only (a mesh-sharded oracle keeps the dense grid so the
+    # batch still shards).  Tree-identical to the unmasked build
+    # (tests/test_partition.py).
+    mask_point_solves: bool = True
     seed: int = 0
 
     def __post_init__(self) -> None:

@@ -253,7 +253,11 @@ def _solve_simplex_min_one(prob: DeviceProblem, bary_M: jax.Array,
     # and value alone is the (possibly looser) valid lower bound.
     obj = (sol.obj - rho_elastic * t_elastic
            - 0.5 * _ELASTIC_QUAD * t_elastic ** 2)
-    return obj + prob.cconst[d], sol.converged, sol.feasible
+    # t_elastic doubles as a feasibility witness: the elastic optimum with
+    # t = 0 is a feasible point of the HARD problem on R, so t <= tol
+    # proves feasibility-somewhere without a separate phase-1 solve
+    # (solve_simplex_min runs phase-1 only when t suggests otherwise).
+    return obj + prob.cconst[d], sol.converged, sol.feasible, t_elastic
 
 
 class Oracle:
@@ -370,6 +374,11 @@ class Oracle:
             jax.vmap(lambda th, d: _solve_one(
                 self.prob, th, d, self.n_iter, self.n_f32),
                 in_axes=(0, 0)))
+        # One (point, delta) pair at a time -- the serial-baseline path of
+        # solve_pairs (one QP per program, matching the 'serial' contract).
+        self._solve_pair_one = jax.jit(
+            lambda th, d: _solve_one(self.prob, th, d, self.n_iter,
+                                     self.n_f32))
 
     @staticmethod
     def _scaled_cond(H: np.ndarray) -> float:
@@ -488,29 +497,60 @@ class Oracle:
                   violation t* > tol) -- excludable from the V* lower bound;
         - -inf:   no usable bound (either solve stalled) -- conservatively
                   blocks certification, forcing a split.
+
+        The phase-1 + Farkas solve runs ONLY on pairs whose elastic min
+        came back with slack > tol or unconverged (the candidates for the
+        +inf upgrade): a converged elastic solve with t == 0 has exhibited
+        a hard-feasible point on R, so its phase-1 could never certify
+        infeasibility and is pure waste.  r3's TPU north-star spent ~2
+        joint QPs per pending pair; the common (feasible) case now costs 1.
         """
         K = bary_Ms.shape[0]
         if K == 0:
             return np.zeros(0), np.zeros(0, dtype=bool)
-        self.n_solves += 2 * K
-        self.n_simplex_solves += 2 * K
+        self.n_solves += K
+        self.n_simplex_solves += K
         cap = self.max_simplex_rows_per_call
         outs, feas_sw = [], []
         for lo in range(0, K, cap):
             Mj, dj = self._pad_simplex(bary_Ms[lo:lo + cap],
                                        delta_idx[lo:lo + cap])
             Kc = min(cap, K - lo)
-            V, conv, _feas = self._simplex_min(Mj, dj)
-            t, t_conv, farkas = self._simplex_feas(Mj, dj)
+            V, conv, _feas, t_el = self._simplex_min(Mj, dj)
             V, conv = np.asarray(V)[:Kc], np.asarray(conv)[:Kc]
-            t, t_conv = np.asarray(t)[:Kc], np.asarray(t_conv)[:Kc]
-            infeasible = t_conv & (t > 1e-6) & np.asarray(farkas)[:Kc]
-            feasible_somewhere = t_conv & (t <= 1e-6)
+            t_el = np.asarray(t_el)[:Kc]
             out = np.where(conv, V, -_INF)
-            out = np.where(infeasible, _INF, out)
+            feasible_somewhere = conv & (t_el <= 1e-6)
+            need_p1 = ~feasible_somewhere
+            if np.any(need_p1):
+                idx = np.where(need_p1)[0]
+                self.n_solves += idx.size
+                self.n_simplex_solves += idx.size
+                t, t_conv, farkas = self._run_simplex_feas(
+                    bary_Ms[lo:lo + cap][idx], delta_idx[lo:lo + cap][idx])
+                infeasible = t_conv & (t > 1e-6) & farkas
+                out[idx[infeasible]] = _INF
+                feasible_somewhere[idx] = t_conv & (t <= 1e-6)
             outs.append(out)
             feas_sw.append(feasible_somewhere)
         return np.concatenate(outs), np.concatenate(feas_sw)
+
+    def _run_simplex_feas(self, Ms: np.ndarray, ds: np.ndarray
+                          ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """One padded+chunked pass of the joint phase-1 program (raw
+        (t*, converged, farkas) triplets, no solve counting -- callers
+        count)."""
+        K = Ms.shape[0]
+        cap = self.max_simplex_rows_per_call
+        ts, convs, fks = [], [], []
+        for lo in range(0, K, cap):
+            Mj, dj = self._pad_simplex(Ms[lo:lo + cap], ds[lo:lo + cap])
+            Kc = min(cap, K - lo)
+            t, conv, farkas = self._simplex_feas(Mj, dj)
+            ts.append(np.asarray(t)[:Kc])
+            convs.append(np.asarray(conv)[:Kc])
+            fks.append(np.asarray(farkas)[:Kc])
+        return np.concatenate(ts), np.concatenate(convs), np.concatenate(fks)
 
     def simplex_feasibility(self, bary_Ms: np.ndarray,
                             delta_idx: np.ndarray
@@ -530,20 +570,65 @@ class Oracle:
         self.n_solves += K
         self.n_simplex_solves += K
         delta_idx = np.asarray(delta_idx, dtype=np.int64)
-        cap = self.max_simplex_rows_per_call
-        ts, feas_sw, infeas = [], [], []
-        for lo in range(0, K, cap):
-            Mj, dj = self._pad_simplex(bary_Ms[lo:lo + cap],
-                                       delta_idx[lo:lo + cap])
-            Kc = min(cap, K - lo)
-            t, conv, farkas = self._simplex_feas(Mj, dj)
-            t, conv, farkas = (np.asarray(t)[:Kc], np.asarray(conv)[:Kc],
-                               np.asarray(farkas)[:Kc])
-            ts.append(t)
-            feas_sw.append(conv & (t <= 1e-6))
-            infeas.append(conv & (t > 1e-6) & farkas)
-        return (np.concatenate(ts), np.concatenate(feas_sw),
-                np.concatenate(infeas))
+        t, conv, farkas = self._run_simplex_feas(bary_Ms, delta_idx)
+        return t, conv & (t <= 1e-6), conv & (t > 1e-6) & farkas
+
+    # -- fixed-commutation (point, delta) pair solves ----------------------
+
+    # Pair-batch cap per device program: same role as
+    # max_simplex_rows_per_call -- bounds the compiled-shape set to
+    # {8..cap}, all warmable up front.  Each pair gathers its own
+    # (H[d], G[d], ...) slice, so memory scales with the cap, not nd.
+    max_pairs_per_call: int = 4096
+
+    def solve_pairs(self, thetas: np.ndarray, delta_idx: np.ndarray
+                    ) -> tuple[np.ndarray, np.ndarray, np.ndarray,
+                               np.ndarray, np.ndarray]:
+        """P_theta_delta at given (point, commutation) pairs: the sparse
+        counterpart of solve_vertices' dense (points x ALL commutations)
+        grid.  The frontier engine uses it to solve ONLY the commutations
+        not already Farkas-excluded on an ancestor simplex (masked vertex
+        solves): deep in a subdivision tail most commutations are
+        known-infeasible, and the dense grid re-solved every one of them
+        at every new vertex (r3 TPU north-star telemetry).
+
+        Returns (V (K,), converged (K,), grad (K, n_theta), u0 (K, n_u),
+        z (K, nz)); V is +inf where unconverged, matching
+        solve_vertices' encoding.
+        """
+        thetas = np.atleast_2d(np.asarray(thetas, dtype=np.float64))
+        K = thetas.shape[0]
+        nt, nu, nz = self.can.n_theta, self.can.n_u, self.can.nz
+        if K == 0:
+            return (np.zeros(0), np.zeros(0, dtype=bool), np.zeros((0, nt)),
+                    np.zeros((0, nu)), np.zeros((0, nz)))
+        delta_idx = np.asarray(delta_idx, dtype=np.int64)
+        self.n_solves += K
+        self.n_point_solves += K
+        if self.backend == "serial":
+            outs = [self._solve_pair_one(jnp.asarray(t), int(d))
+                    for t, d in zip(thetas, delta_idx)]
+            parts = [np.stack([np.asarray(o[k]) for o in outs])
+                     for k in range(5)]
+        else:
+            cap = self.max_pairs_per_call
+            chunks = []
+            for lo in range(0, K, cap):
+                chunk_t = thetas[lo:lo + cap]
+                chunk_d = delta_idx[lo:lo + cap]
+                Kc = chunk_t.shape[0]
+                Kpad = max(8, min(cap, 1 << (Kc - 1).bit_length()))
+                tpad = np.concatenate(
+                    [chunk_t, np.zeros((Kpad - Kc, nt))])
+                dpad = np.concatenate(
+                    [chunk_d, np.zeros(Kpad - Kc, dtype=np.int64)])
+                out = self._solve_fixed(jnp.asarray(tpad), jnp.asarray(dpad))
+                chunks.append([np.asarray(o)[:Kc] for o in out])
+            parts = [np.concatenate([c[k] for c in chunks])
+                     for k in range(5)]
+        V, conv, grad, u0, z = parts
+        conv = conv.astype(bool)
+        return np.where(conv, V, _INF), conv, grad, u0, z
 
     # -- fixed-commutation point solve (the semi-explicit ONLINE stage) ----
 
@@ -562,21 +647,11 @@ class Oracle:
         Returns (u0 (K, n_u), V (K,), converged (K,), z (K, nz)).
         """
         thetas = np.atleast_2d(np.asarray(thetas, dtype=np.float64))
-        K = thetas.shape[0]
-        if K == 0:
+        if thetas.shape[0] == 0:
             return (np.zeros((0, self.can.n_u)), np.zeros(0),
                     np.zeros(0, dtype=bool), np.zeros((0, self.can.nz)))
-        self.n_solves += K
-        self.n_point_solves += K
-        Kpad = max(8, 1 << (K - 1).bit_length())
-        tpad = np.concatenate(
-            [thetas, np.zeros((Kpad - K, thetas.shape[1]))])
-        dpad = np.concatenate([np.asarray(delta_idx, dtype=np.int64),
-                               np.zeros(Kpad - K, dtype=np.int64)])
-        V, conv, _grad, u0, z = self._solve_fixed(jnp.asarray(tpad),
-                                                  jnp.asarray(dpad))
-        return (np.asarray(u0)[:K], np.asarray(V)[:K],
-                np.asarray(conv)[:K].astype(bool), np.asarray(z)[:K])
+        V, conv, _grad, u0, z = self.solve_pairs(thetas, delta_idx)
+        return u0, V, conv, z
 
     # -- pointwise feasibility (phase-1) -----------------------------------
 

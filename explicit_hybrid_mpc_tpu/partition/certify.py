@@ -102,9 +102,14 @@ def tangent_gaps(sd: SimplexVertexData, U: np.ndarray) -> np.ndarray:
     """
     # tangents[i, j, d] = V[i, d] + grad[i, d] . (v_j - v_i)
     dv = sd.verts[None, :, :] - sd.verts[:, None, :]      # (p+1, p+1, p)
-    t = sd.V[:, None, :] + np.einsum("ijk,idk->ijd", dv, sd.grad)
-    slack = U[None, :, None] - t                          # (i, j, d)
-    worst = np.max(slack, axis=1)                         # (i, d) max over j
+    # Unconverged cells hold V=+inf with garbage grad (possibly inf/nan,
+    # e.g. masked-skip fabrications): inf arithmetic raises 'invalid
+    # value' warnings, yet every such lane is overwritten by the conv
+    # mask below.
+    with np.errstate(invalid="ignore"):
+        t = sd.V[:, None, :] + np.einsum("ijk,idk->ijd", dv, sd.grad)
+        slack = U[None, :, None] - t                      # (i, j, d)
+        worst = np.max(slack, axis=1)                     # (i, d) max over j
     worst = np.where(sd.conv, worst, np.inf)              # only valid tangents
     gap = np.min(worst, axis=0)                           # (d,) min over i
     none_conv = ~np.any(sd.conv, axis=0)

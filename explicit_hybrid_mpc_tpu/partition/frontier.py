@@ -62,11 +62,17 @@ class VertexCache:
     def get(self, v: np.ndarray) -> tuple:
         return self._d[geometry.vertex_key(v)]
 
+    def get_key(self, k: bytes) -> tuple | None:
+        return self._d.get(k)
+
     def put(self, v: np.ndarray, row: tuple) -> None:
+        self.put_key(geometry.vertex_key(v), row)
+
+    def put_key(self, k: bytes, row: tuple) -> None:
         if not self._row_bytes:
             self._row_bytes = sum(
                 a.nbytes if isinstance(a, np.ndarray) else 8 for a in row)
-        self._d[geometry.vertex_key(v)] = row
+        self._d[k] = row
         if len(self._d) > self.peak_vertices:
             self.peak_vertices = len(self._d)
             self.peak_bytes = self.peak_vertices * self._row_bytes
@@ -104,6 +110,10 @@ class FrontierEngine:
         self.n_uncertified = 0
         self.n_unique_solves = 0
         self.n_device_failures = 0
+        self.n_point_skips = 0
+        # Interned all-True active-delta mask (shared by every full cache
+        # row; never mutated -- partial masks are fresh copies).
+        self._full_mask = np.ones(oracle.can.n_delta, dtype=bool)
         self._fb_oracle: Oracle | None = None
         self._oracle_s = 0.0
         # vertex key -> number of OPEN simplices (frontier + in-flight)
@@ -202,23 +212,116 @@ class FrontierEngine:
     # -- vertex solves -----------------------------------------------------
 
     def _solve_missing(self, nodes: list[int]) -> None:
-        missing: list[np.ndarray] = []
-        seen: set[bytes] = set()
+        """Solve every (vertex, commutation) cell the certificates of
+        `nodes` can read but the cache does not hold.
+
+        Masked path (cfg.mask_point_solves): a commutation Farkas-excluded
+        on an ancestor simplex is infeasible at every point of the child
+        (child subset of ancestor), so its point QP at any child vertex is
+        known-infeasible without solving.  Each node contributes an
+        active-delta set (all minus its inherited +inf exclusions); a
+        vertex shared by several nodes needs the UNION.  Vertices needing
+        every commutation go through the dense solve_vertices grid (warm
+        buckets, mesh-shardable); partially-needed vertices go through the
+        sparse solve_pairs path, and cached rows widen in place when a
+        later node needs commutations an earlier requester excluded.
+        Fabricated cells (V=+inf, conv=False) encode exactly what the
+        skipped solve would have returned for an infeasible QP, so the
+        build is tree-identical to the unmasked one."""
+        nd = self.oracle.can.n_delta
+        full = self._full_mask
+        use_mask = (nd > 1 and self.oracle.mesh is None
+                    and getattr(self.cfg, "mask_point_solves", True)
+                    and getattr(self.cfg, "inherit_bounds", True))
+        need: dict[bytes, np.ndarray] = {}
+        vert: dict[bytes, np.ndarray] = {}
         for n in nodes:
+            act = full
+            if use_mask and n in self._inherit:
+                excl = [d for d, b in self._inherit[n].items()
+                        if b == np.inf]
+                if excl:
+                    act = full.copy()
+                    act[excl] = False
             for v in self.tree.vertices[n]:
                 k = geometry.vertex_key(v)
-                if k not in seen and v not in self.cache:
-                    seen.add(k)
-                    missing.append(v)
-        if not missing:
-            return
-        thetas = np.stack(missing)
-        self.n_unique_solves += len(missing)
-        sol: VertexSolution = self._oracle_call("solve_vertices", thetas)
-        for i, v in enumerate(missing):
-            self.cache.put(v, (sol.V[i], sol.conv[i], sol.grad[i],
-                               sol.u0[i], sol.z[i], sol.Vstar[i],
-                               sol.dstar[i]))
+                cur = need.get(k)
+                if cur is None:
+                    need[k] = act
+                    vert[k] = v
+                elif cur is not full and act is not cur:
+                    need[k] = full if act is full else (cur | act)
+        grid_pts: list[np.ndarray] = []
+        grid_keys: list[bytes] = []
+        pair_t: list[np.ndarray] = []
+        pair_d: list[int] = []
+        # (key, delta indices, offset into the pair batch)
+        pair_slices: list[tuple[bytes, np.ndarray, int]] = []
+        for k, m in need.items():
+            row = self.cache.get_key(k)
+            if row is None:
+                if m.all():
+                    grid_pts.append(vert[k])
+                    grid_keys.append(k)
+                    continue
+                missing_d = m
+                self.n_point_skips += int(nd - m.sum())
+            else:
+                missing_d = m & ~row[7]
+                if not missing_d.any():
+                    continue
+            ds = np.where(missing_d)[0]
+            if row is None:
+                # Widenings of an existing row are top-ups of a vertex
+                # already counted -- n_unique_solves stays a count of
+                # distinct vertices ever solved, same meaning as the
+                # unmasked build's.
+                self.n_unique_solves += 1
+            pair_slices.append((k, ds, len(pair_d)))
+            pair_t.extend([vert[k]] * ds.size)
+            pair_d.extend(ds.tolist())
+        self.n_unique_solves += len(grid_pts)
+        if grid_pts:
+            sol: VertexSolution = self._oracle_call(
+                "solve_vertices", np.stack(grid_pts))
+            for i, k in enumerate(grid_keys):
+                self.cache.put_key(k, (sol.V[i], sol.conv[i], sol.grad[i],
+                                       sol.u0[i], sol.z[i], sol.Vstar[i],
+                                       sol.dstar[i], full))
+        if pair_slices:
+            V, conv, grad, u0, z = self._oracle_call(
+                "solve_pairs", np.stack(pair_t),
+                np.asarray(pair_d, dtype=np.int64))
+            nt, nu, nz = (self.problem.n_theta, self.problem.n_u,
+                          self.oracle.can.nz)
+            for k, ds, lo in pair_slices:
+                row = self.cache.get_key(k)
+                if row is None:
+                    Vr = np.full(nd, np.inf)
+                    convr = np.zeros(nd, dtype=bool)
+                    gradr = np.zeros((nd, nt))
+                    u0r = np.zeros((nd, nu))
+                    zr = np.zeros((nd, nz))
+                    maskr = np.zeros(nd, dtype=bool)
+                else:
+                    Vr, convr, gradr = (row[0].copy(), row[1].copy(),
+                                        row[2].copy())
+                    u0r, zr = row[3].copy(), row[4].copy()
+                    maskr = row[7].copy()
+                sl = slice(lo, lo + ds.size)
+                Vr[ds], convr[ds], gradr[ds] = V[sl], conv[sl], grad[sl]
+                u0r[ds], zr[ds] = u0[sl], z[sl]
+                maskr[ds] = True
+                # Same reduction as oracle.reduce_deltas (first minimum):
+                # skipped cells are +inf/unconverged, so the subset argmin
+                # equals the full-grid argmin.
+                Vval = np.where(convr, Vr, np.inf)
+                j = int(np.argmin(Vval))
+                Vs = Vval[j]
+                self.cache.put_key(k, (Vr, convr, gradr, u0r, zr, Vs,
+                                       np.int64(j if np.isfinite(Vs)
+                                                else -1),
+                                       full if maskr.all() else maskr))
 
     def _vertex_data(self, node: int) -> certify.SimplexVertexData:
         verts = self.tree.vertices[node]
@@ -495,6 +598,10 @@ class FrontierEngine:
             # vertex rows, plus total unique vertex solves (the
             # work-sharing metric the cache exists for).
             "unique_vertex_solves": self.n_unique_solves,
+            # (vertex, commutation) point QPs skipped because the
+            # commutation was Farkas-excluded on an ancestor simplex
+            # (cfg.mask_point_solves).
+            "masked_point_skips": self.n_point_skips,
             "device_failures": self.n_device_failures,
             "cache_peak_vertices": self.cache.peak_vertices,
             "cache_peak_mb": round(self.cache.peak_bytes / 2**20, 2),
@@ -529,6 +636,7 @@ class FrontierEngine:
                 "inherit": {n: self._inherit[n] for n in self.frontier
                             if n in self._inherit},
                 "n_inherited_skips": self.n_inherited_skips,
+                "n_point_skips": self.n_point_skips,
                 "cfg": self.cfg,
             }, f, protocol=pickle.HIGHEST_PROTOCOL)
 
@@ -562,6 +670,13 @@ class FrontierEngine:
         eng.n_device_failures = 0
         eng._inherit = dict(snap.get("inherit", {}))
         eng.n_inherited_skips = snap.get("n_inherited_skips", 0)
+        eng.n_point_skips = snap.get("n_point_skips", 0)
+        eng._full_mask = np.ones(oracle.can.n_delta, dtype=bool)
+        # Cache rows from pre-masking checkpoints lack the solved-delta
+        # mask (8th element): every cell in them was actually solved.
+        for k, row in eng.cache._d.items():
+            if len(row) == 7:
+                eng.cache._d[k] = (*row, eng._full_mask)
         eng._fb_oracle = None
         eng._oracle_s = 0.0
         oracle.n_solves = snap.get("n_solves", 0)

@@ -94,20 +94,70 @@ def needed() -> list:
             if artifact_platform(c[0], c[4]) not in ("tpu", "gpu")]
 
 
+def _progress_mtime(name: str) -> float:
+    """Latest mtime over every file the capture streams to (stdout log,
+    artifact json, sibling .jsonl/.log files sharing the stem)."""
+    stem = name.replace(".json", "")
+    newest = 0.0
+    try:
+        for f in os.listdir(ART):
+            if f.startswith(stem):
+                newest = max(newest,
+                             os.path.getmtime(os.path.join(ART, f)))
+    except OSError:
+        pass
+    return newest
+
+
 def run_capture(name: str, script: str, env_extra: dict, timeout: float) -> bool:
-    log(f"capture {name} via {script} (timeout {timeout}s)")
+    """Run one capture with BOTH a hard timeout and a stall watchdog.
+
+    Observed r3 failure mode: a device call through the axon tunnel that
+    never returns.  Every capture script writes its log/artifact
+    incrementally (a JSONL line per frontier step, a log line per warmup
+    bucket), so "no file under artifacts/<stem>* changed for
+    WATCH_STALL_S seconds" (default 900 -- comfortably above the longest
+    legitimate gap, a ~4 min mid-run tunnel compile) means the child is
+    wedged; kill it and salvage whatever sections it already wrote
+    instead of burning the whole hard timeout (2.5 h for north_star)."""
+    stall_s = float(os.environ.get("WATCH_STALL_S", "900"))
+    log(f"capture {name} via {script} (timeout {timeout}s, "
+        f"stall kill {stall_s}s)")
     env = dict(os.environ)
     env.pop("JAX_PLATFORMS", None)
+    # Persistent compilation cache shared by every capture process: the
+    # same warmup buckets recompile in each script through the tunnel
+    # (minutes each); cached, they reload in seconds.
+    env.setdefault("JAX_COMPILATION_CACHE_DIR",
+                   os.path.join(REPO, ".jax_cache"))
+    env.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "2")
     env.update(env_extra)
     logpath = os.path.join(ART, name.replace(".json", ".log"))
     os.makedirs(ART, exist_ok=True)
-    try:
-        with open(logpath, "w") as lf:
-            subprocess.run([sys.executable, script], cwd=REPO, env=env,
-                           stdout=lf, stderr=subprocess.STDOUT,
-                           timeout=timeout)
-    except subprocess.TimeoutExpired:
-        log(f"  {name}: TIMED OUT after {timeout}s")
+    with open(logpath, "w") as lf:
+        child = subprocess.Popen([sys.executable, script], cwd=REPO,
+                                 env=env, stdout=lf,
+                                 stderr=subprocess.STDOUT)
+        t0 = time.time()
+        while child.poll() is None:
+            time.sleep(20)
+            now = time.time()
+            if now - t0 > timeout:
+                log(f"  {name}: TIMED OUT after {timeout}s")
+                child.kill()
+                child.wait()
+                break
+            last = max(_progress_mtime(name), t0)
+            if now - last > stall_s:
+                log(f"  {name}: STALLED ({stall_s}s with no file "
+                    "progress); killing")
+                child.terminate()
+                try:
+                    child.wait(timeout=20)
+                except subprocess.TimeoutExpired:
+                    child.kill()
+                    child.wait()
+                break
     plat = artifact_platform(name, dict(zip([c[0] for c in CAPTURES],
                                             [c[4] for c in CAPTURES]))[name])
     log(f"  {name}: platform={plat}")

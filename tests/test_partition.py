@@ -223,3 +223,32 @@ def test_serial_vs_batched_region_parity():
         res = build_partition(prob, cfg, Oracle(prob, backend=backend))
         counts[backend] = (res.stats["regions"], res.stats["tree_nodes"])
     assert counts["serial"] == counts["cpu"]
+
+
+def test_masked_point_solves_tree_parity_and_savings():
+    """cfg.mask_point_solves skips point QPs for commutations
+    Farkas-excluded on an ancestor.  A skipped cell is fabricated as
+    (V=+inf, conv=False) -- exactly what the solver returns for an
+    infeasible QP -- so the build must be TREE-IDENTICAL to the unmasked
+    one while issuing measurably fewer point solves."""
+    prob = make("inverted_pendulum", N=3)
+    out = {}
+    for masked in (False, True):
+        cfg = PartitionConfig(problem="inverted_pendulum", eps_a=0.5,
+                              backend="cpu", batch_simplices=64,
+                              max_depth=14, mask_point_solves=masked)
+        res = build_partition(prob, cfg, Oracle(prob, backend="cpu"))
+        leaves = res.tree.converged_leaves()
+        out[masked] = (res.stats, leaves,
+                       [res.tree.leaf_data[n].delta_idx for n in leaves],
+                       [res.tree.vertices[n] for n in leaves])
+    sa, sb = out[False][0], out[True][0]
+    assert sa["regions"] == sb["regions"]
+    assert sa["tree_nodes"] == sb["tree_nodes"]
+    assert out[False][2] == out[True][2]
+    for Va, Vb in zip(out[False][3], out[True][3]):
+        np.testing.assert_array_equal(Va, Vb)
+    # The point of the feature: skipped point QPs, identical everything.
+    assert sb["masked_point_skips"] > 0
+    assert sb["point_solves"] < sa["point_solves"]
+    assert sa["masked_point_skips"] == 0

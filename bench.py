@@ -179,6 +179,21 @@ def warm_oracle(oracle, problem, stop_after: float | None = None) -> None:
             retry_transient(lambda: oracle.solve_pairs(pts, ds),
                             what=f"pair warmup {b}")
             b *= 2
+    # Rescue-program buckets (full-length cold-f64 re-solve of schedule
+    # stragglers): warmed only when enabled.
+    if getattr(oracle, "rescue_iter", 0) > 0:
+        b = 8
+        while b <= oracle.max_pairs_per_call:
+            if stop_after is not None and time.time() > stop_after:
+                log(f"warmup stopped early at rescue bucket {b}")
+                break
+            log(f"warmup: rescue bucket {b}")
+            pts = rng.uniform(problem.theta_lb, problem.theta_ub,
+                              size=(b, problem.n_theta))
+            ds = (np.arange(b, dtype=np.int64) % nd)
+            retry_transient(lambda: oracle._rescue_pairs(pts, ds),
+                            what=f"rescue warmup {b}")
+            b *= 2
     # Simplex-query buckets.  solve_simplex_min warms the min-QP program;
     # its phase-1 pass now runs only on suspect subsets, so the phase-1
     # program is warmed explicitly via simplex_feasibility at every
@@ -255,6 +270,7 @@ def run(result: dict) -> None:
                                max_steps=50, time_budget_s=120.0)
     build_partition(problem, warm_cfg, oracle=oracle)
     oracle.n_solves = oracle.n_point_solves = oracle.n_simplex_solves = 0
+    oracle.n_rescue_solves = 0
 
     remaining = deadline() - time.time() - 90.0  # reserve for baseline
     budget = max(60.0, min(time_budget, remaining))

@@ -69,6 +69,14 @@ class PartitionConfig:
     # float64) or 'mixed' (f32 bulk + f64 polish to the same KKT
     # tolerance; ~3x less f64 work -- the TPU-fast path).
     precision: str = "f64"
+    # Optional (n_f32, n_f64) schedule override for the POINT-class IPM
+    # programs only (the joint simplex programs keep the full schedule;
+    # they need it).  Pair with ipm_rescue_iters so schedule misses cost
+    # one extra solve instead of certification failures.
+    ipm_point_schedule: Optional[tuple] = None
+    # Full-length cold-f64 re-solve of feasible-but-unconverged point
+    # solves (0 disables).  See Oracle(rescue_iter=...).
+    ipm_rescue_iters: int = 0
     # Inherit per-commutation stage-2 facts (Farkas infeasibility
     # exclusions, simplex-min lower bounds) from parent to children across
     # bisections.  Certified-exact decision parity with the uninherited

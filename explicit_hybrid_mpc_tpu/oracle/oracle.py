@@ -77,6 +77,10 @@ class VertexSolution(NamedTuple):
 
     V: np.ndarray        # (P, nd) fixed-commutation value; +inf if invalid
     conv: np.ndarray     # (P, nd) bool, solver converged (cost trustworthy)
+    feas: np.ndarray     # (P, nd) bool, primal residual small: separates
+    #                      "unconverged because infeasible" from
+    #                      "unconverged because the schedule was short"
+    #                      (the rescue pass re-solves only the latter)
     grad: np.ndarray     # (P, nd, n_theta) dV_delta/dtheta
     u0: np.ndarray       # (P, nd, n_u) first control move
     z: np.ndarray        # (P, nd, nz) full primal solution (interpolating
@@ -102,7 +106,7 @@ def _solve_one(prob: DeviceProblem, theta: jax.Array, d: int, n_iter: int,
     # Affine theta part is nonzero only under prestabilized condensing
     # (z holds v; the applied input is u = K x(theta) + v).
     u0 = prob.u_map[d] @ sol.z + prob.u_theta[d] @ theta + prob.u_const[d]
-    return V, sol.converged, grad, u0, sol.z
+    return V, sol.converged, sol.feasible, grad, u0, sol.z
 
 
 def _solve_points_grid(prob: DeviceProblem, thetas: jax.Array, n_iter: int,
@@ -138,9 +142,10 @@ def reduce_deltas(V: jax.Array, conv: jax.Array):
 def _solve_points_all_deltas(prob: DeviceProblem, thetas: jax.Array,
                              n_iter: int, n_f32: int = 0):
     """(P points) x (nd commutations) in one vmapped program."""
-    V, conv, grad, u0, z = _solve_points_grid(prob, thetas, n_iter, n_f32)
+    V, conv, feas, grad, u0, z = _solve_points_grid(prob, thetas, n_iter,
+                                                    n_f32)
     Vstar, dstar = reduce_deltas(V, conv)
-    return V, conv, grad, u0, z, Vstar, dstar
+    return V, conv, feas, grad, u0, z, Vstar, dstar
 
 
 def _simplex_feas_one(prob: DeviceProblem, bary_M: jax.Array, d: int,
@@ -266,7 +271,9 @@ class Oracle:
     def __init__(self, problem, backend: str = "cpu", n_iter: int = 30,
                  mesh=None, precision: str = "f64",
                  points_cap: int | None = None,
-                 n_f32: int | None = None):
+                 n_f32: int | None = None,
+                 rescue_iter: int = 0,
+                 point_schedule: tuple[int, int] | None = None):
         """mesh: optional jax.sharding.Mesh with ("batch", "delta") axes;
         when given, solve_vertices shards the (points x commutations) grid
         over it (parallel/mesh.py) instead of running on a single device --
@@ -318,6 +325,24 @@ class Oracle:
         self.n_f32 = ((2 * n_iter) // 3 if n_f32 is None else n_f32) \
             if precision == "mixed" else 0
         self.n_iter = n_iter - self.n_f32
+        # point_schedule = (n_f32, n_f64) override for the POINT-class
+        # programs only (vertex grid, sparse pairs, fixed-delta, point
+        # phase-1).  Measured r3: feasible point QPs converge in ~12-16
+        # total iterations while the joint simplex QPs (larger, elastic)
+        # need the full schedule -- so the point class can run an
+        # aggressive schedule (rescue_iter catching the stragglers)
+        # without touching the simplex class.  None = same schedule as
+        # the simplex class (previous behavior).  An explicit
+        # point_schedule, like an explicit n_f32, bypasses the
+        # conditioning gate (tuning scripts own the risk).
+        if point_schedule is None:
+            self.point_n_f32, self.point_n_iter = self.n_f32, self.n_iter
+        else:
+            self.point_n_f32, self.point_n_iter = map(int, point_schedule)
+            if self.point_n_f32 < 0 or self.point_n_iter < 1:
+                raise ValueError(f"bad point_schedule {point_schedule!r}: "
+                                 "need (n_f32 >= 0, n_f64 >= 1)")
+        self.point_schedule = point_schedule
         self.mesh = mesh
         # Statistics: individual QP solves issued, split by kind -- the
         # point QPs (fixed-commutation solves at a parameter point) and
@@ -327,6 +352,19 @@ class Oracle:
         self.n_solves = 0
         self.n_point_solves = 0
         self.n_simplex_solves = 0
+        # rescue_iter > 0 enables the per-instance rescue pass: point
+        # solves that end FEASIBLE (small primal residual -- so not an
+        # infeasible commutation, which can never converge) but
+        # UNCONVERGED under the configured schedule are re-solved cold
+        # with a full-length rescue_iter-iteration f64 schedule.  This
+        # makes aggressive mixed schedules (short emulated-f64 polish on
+        # TPU) safe by construction: a schedule miss costs one extra
+        # solve for that instance instead of a certification failure and
+        # extra splits.  Deterministic per instance (the decision depends
+        # only on the instance's own iterates), so trees stay
+        # batch-composition-independent.
+        self.rescue_iter = int(rescue_iter)
+        self.n_rescue_solves = 0
         if backend in ("tpu", "gpu", "device"):
             platform = None  # default platform (the accelerator if present)
         elif backend in ("cpu", "serial"):
@@ -349,22 +387,27 @@ class Oracle:
         if mesh is not None:
             from explicit_hybrid_mpc_tpu.parallel.mesh import MeshSolver
             self._mesh_solver = MeshSolver(to_device(self.can), mesh,
-                                           n_iter=self.n_iter,
-                                           n_f32=self.n_f32)
+                                           n_iter=self.point_n_iter,
+                                           n_f32=self.point_n_f32)
 
         self._solve_points = jax.jit(
-            functools.partial(_solve_points_all_deltas, n_iter=self.n_iter,
-                              n_f32=self.n_f32),
+            functools.partial(_solve_points_all_deltas,
+                              n_iter=self.point_n_iter,
+                              n_f32=self.point_n_f32),
             static_argnames=())
         self._solve_one_point = jax.jit(
             lambda prob, theta: _solve_points_all_deltas(
-                prob, theta[None], self.n_iter, self.n_f32))
+                prob, theta[None], self.point_n_iter, self.point_n_f32))
         self._simplex_min = jax.jit(
             jax.vmap(lambda M, d: _solve_simplex_min_one(
                 self.prob, M, d, self.n_iter, self.n_f32), in_axes=(0, 0)))
         self._simplex_feas = jax.jit(
             jax.vmap(lambda M, d: _simplex_feas_one(
                 self.prob, M, d, self.n_iter, self.n_f32), in_axes=(0, 0)))
+        # Phase-1 keeps the FULL schedule even under an aggressive
+        # point_schedule: it returns a violation scalar with no
+        # convergence flag, so a schedule miss has no rescue signal and
+        # would silently misclassify feasibility (the unsound direction).
         self._point_feas = jax.jit(
             jax.vmap(lambda th, d: ipm.phase1(
                 self.prob.G[d],
@@ -372,13 +415,21 @@ class Oracle:
                 n_iter=self.n_iter, n_f32=self.n_f32), in_axes=(0, 0)))
         self._solve_fixed = jax.jit(
             jax.vmap(lambda th, d: _solve_one(
-                self.prob, th, d, self.n_iter, self.n_f32),
+                self.prob, th, d, self.point_n_iter, self.point_n_f32),
                 in_axes=(0, 0)))
         # One (point, delta) pair at a time -- the serial-baseline path of
         # solve_pairs (one QP per program, matching the 'serial' contract).
         self._solve_pair_one = jax.jit(
-            lambda th, d: _solve_one(self.prob, th, d, self.n_iter,
-                                     self.n_f32))
+            lambda th, d: _solve_one(self.prob, th, d, self.point_n_iter,
+                                     self.point_n_f32))
+        if self.rescue_iter > 0:
+            self._solve_rescue = jax.jit(
+                jax.vmap(lambda th, d: _solve_one(
+                    self.prob, th, d, self.rescue_iter, 0),
+                    in_axes=(0, 0)))
+            self._rescue_one = jax.jit(
+                lambda th, d: _solve_one(self.prob, th, d,
+                                         self.rescue_iter, 0))
 
     @staticmethod
     def _scaled_cond(H: np.ndarray) -> float:
@@ -404,7 +455,7 @@ class Oracle:
         size, and the number of distinct padded shapes XLA ever
         compiles."""
         nd = max(1, self.can.n_delta)
-        budget = 65536 if self.n_f32 == 0 else 32768
+        budget = 65536 if self.point_n_f32 == 0 else 32768
         cap = 1 << max(3, (budget // nd).bit_length() - 1)
         return min(self.points_cap or 2048, 2048, cap)
 
@@ -419,6 +470,7 @@ class Oracle:
         if P == 0:
             return VertexSolution(
                 V=np.zeros((0, nd)), conv=np.zeros((0, nd), dtype=bool),
+                feas=np.zeros((0, nd), dtype=bool),
                 grad=np.zeros((0, nd, nt)), u0=np.zeros((0, nd, nu)),
                 z=np.zeros((0, nd, nz)), Vstar=np.zeros(0),
                 dstar=np.zeros(0, dtype=np.int64))
@@ -428,32 +480,92 @@ class Oracle:
             outs = [self._solve_one_point(self.prob, jnp.asarray(t))
                     for t in thetas]
             parts = [np.concatenate([np.asarray(o[k]) for o in outs])
-                     for k in range(7)]
-            return VertexSolution(*self._finalize(parts))
-        cap = self.max_points_per_call
-        chunks = []
-        for lo in range(0, P, cap):
-            chunk = thetas[lo:lo + cap]
-            Pc = chunk.shape[0]
-            if self._mesh_solver is not None:
-                out = self._mesh_solver(chunk)
-                chunks.append([np.asarray(o) for o in out])
-                continue
-            Ppad = min(cap, max(8, 1 << (Pc - 1).bit_length()))
-            pad = np.zeros((Ppad - Pc, thetas.shape[1]))
-            out = self._solve_points(self.prob, jnp.asarray(
-                np.concatenate([chunk, pad])))
-            chunks.append([np.asarray(o)[:Pc] for o in out])
-        parts = [np.concatenate([c[k] for c in chunks]) for k in range(7)]
+                     for k in range(8)]
+        else:
+            cap = self.max_points_per_call
+            chunks = []
+            for lo in range(0, P, cap):
+                chunk = thetas[lo:lo + cap]
+                Pc = chunk.shape[0]
+                if self._mesh_solver is not None:
+                    out = self._mesh_solver(chunk)
+                    chunks.append([np.asarray(o) for o in out])
+                    continue
+                Ppad = min(cap, max(8, 1 << (Pc - 1).bit_length()))
+                pad = np.zeros((Ppad - Pc, thetas.shape[1]))
+                out = self._solve_points(self.prob, jnp.asarray(
+                    np.concatenate([chunk, pad])))
+                chunks.append([np.asarray(o)[:Pc] for o in out])
+            parts = [np.concatenate([c[k] for c in chunks])
+                     for k in range(8)]
+        self._rescue_grid(thetas, parts)
         return VertexSolution(*self._finalize(parts))
+
+    def _rescue_grid(self, thetas: np.ndarray, parts: list) -> None:
+        """Re-solve feasible-but-unconverged grid cells in place (the
+        rescue pass; no-op when rescue_iter == 0 or nothing qualifies)."""
+        if self.rescue_iter <= 0:
+            return
+        V, conv, feas, grad, u0, z, Vstar, dstar = parts
+        pt, ds = np.nonzero(feas & ~conv)
+        if pt.size == 0:
+            return
+        rV, rconv, rfeas, rgrad, ru0, rz = self._rescue_pairs(
+            thetas[pt], ds.astype(np.int64))
+        V[pt, ds] = rV
+        conv[pt, ds] = rconv
+        feas[pt, ds] = rfeas
+        grad[pt, ds] = rgrad
+        u0[pt, ds] = ru0
+        z[pt, ds] = rz
+        # Re-reduce the touched points (same first-minimum tie-break as
+        # reduce_deltas).
+        for p in np.unique(pt):
+            Vval = np.where(conv[p], V[p], _INF)
+            j = int(np.argmin(Vval))
+            Vstar[p] = Vval[j]
+            dstar[p] = j if np.isfinite(Vval[j]) else -1
+
+    def _rescue_pairs(self, thetas: np.ndarray, ds: np.ndarray):
+        """Cold full-length f64 re-solve of (point, delta) pairs with the
+        dedicated rescue program; padded/chunked like solve_pairs."""
+        K = thetas.shape[0]
+        self.n_solves += K
+        self.n_rescue_solves += K
+        if self.backend == "serial":
+            # Keep the serial contract (one QP per program) for rescue
+            # solves too -- the serial baseline's per-solve timing must
+            # not be contaminated by batched programs.
+            outs = [self._rescue_one(jnp.asarray(t), int(d))
+                    for t, d in zip(thetas, ds)]
+            return [np.stack([np.asarray(o[k]) for o in outs])
+                    for k in range(6)]
+        cap = self.max_pairs_per_call
+        chunks = []
+        for lo in range(0, K, cap):
+            tj, dj, Kc = self._pad_pairs(thetas[lo:lo + cap],
+                                         ds[lo:lo + cap])
+            out = self._solve_rescue(tj, dj)
+            chunks.append([np.asarray(o)[:Kc] for o in out])
+        return [np.concatenate([c[k] for c in chunks]) for k in range(6)]
+
+    def _pad_pairs(self, thetas: np.ndarray, ds: np.ndarray):
+        """Pad a (point, delta) pair batch to its power-of-two bucket."""
+        Kc = thetas.shape[0]
+        Kpad = max(8, min(self.max_pairs_per_call,
+                          1 << (Kc - 1).bit_length()))
+        tpad = np.concatenate(
+            [thetas, np.zeros((Kpad - Kc, thetas.shape[1]))])
+        dpad = np.concatenate([ds, np.zeros(Kpad - Kc, dtype=np.int64)])
+        return jnp.asarray(tpad), jnp.asarray(dpad), Kc
 
     @staticmethod
     def _finalize(parts):
-        V, conv, grad, u0, z, Vstar, dstar = parts
+        V, conv, feas, grad, u0, z, Vstar, dstar = parts
         V = np.where(conv, V, _INF)
         dstar = np.where(np.isfinite(Vstar), dstar, -1)
-        return (V, conv.astype(bool), grad, u0, z, Vstar,
-                dstar.astype(np.int64))
+        return (V, conv.astype(bool), feas.astype(bool), grad, u0, z,
+                Vstar, dstar.astype(np.int64))
 
     # -- the simplex-wide bound query (reference: V_R-style) ---------------
 
@@ -609,25 +721,25 @@ class Oracle:
             outs = [self._solve_pair_one(jnp.asarray(t), int(d))
                     for t, d in zip(thetas, delta_idx)]
             parts = [np.stack([np.asarray(o[k]) for o in outs])
-                     for k in range(5)]
+                     for k in range(6)]
         else:
             cap = self.max_pairs_per_call
             chunks = []
             for lo in range(0, K, cap):
-                chunk_t = thetas[lo:lo + cap]
-                chunk_d = delta_idx[lo:lo + cap]
-                Kc = chunk_t.shape[0]
-                Kpad = max(8, min(cap, 1 << (Kc - 1).bit_length()))
-                tpad = np.concatenate(
-                    [chunk_t, np.zeros((Kpad - Kc, nt))])
-                dpad = np.concatenate(
-                    [chunk_d, np.zeros(Kpad - Kc, dtype=np.int64)])
-                out = self._solve_fixed(jnp.asarray(tpad), jnp.asarray(dpad))
+                tj, dj, Kc = self._pad_pairs(thetas[lo:lo + cap],
+                                             delta_idx[lo:lo + cap])
+                out = self._solve_fixed(tj, dj)
                 chunks.append([np.asarray(o)[:Kc] for o in out])
             parts = [np.concatenate([c[k] for c in chunks])
-                     for k in range(5)]
-        V, conv, grad, u0, z = parts
-        conv = conv.astype(bool)
+                     for k in range(6)]
+        V, conv, feas, grad, u0, z = parts
+        conv, feas = conv.astype(bool), feas.astype(bool)
+        if self.rescue_iter > 0 and np.any(feas & ~conv):
+            idx = np.nonzero(feas & ~conv)[0]
+            rV, rconv, _rfeas, rgrad, ru0, rz = self._rescue_pairs(
+                thetas[idx], delta_idx[idx])
+            V[idx], conv[idx], grad[idx] = rV, rconv, rgrad
+            u0[idx], z[idx] = ru0, rz
         return np.where(conv, V, _INF), conv, grad, u0, z
 
     # -- fixed-commutation point solve (the semi-explicit ONLINE stage) ----

@@ -94,16 +94,17 @@ def sharded_grid_solver(mesh: Mesh, n_iter: int, n_f32: int = 0):
     """
 
     def local(prob, thetas, delta_mask):
-        V, conv, grad, u0, z = _solve_points_grid(prob, thetas, n_iter,
-                                                  n_f32)
+        V, conv, feas, grad, u0, z = _solve_points_grid(prob, thetas,
+                                                        n_iter, n_f32)
         conv = conv & delta_mask[None, :]
-        return V, conv, grad, u0, z
+        feas = feas & delta_mask[None, :]
+        return V, conv, feas, grad, u0, z
 
     spec_pd = P("batch", "delta")
     return shard_map(
         local, mesh=mesh,
         in_specs=(P("delta"), P("batch"), P("delta")),
-        out_specs=(spec_pd, spec_pd, spec_pd, spec_pd, spec_pd))
+        out_specs=(spec_pd,) * 6)
 
 
 class MeshSolver:
@@ -138,9 +139,9 @@ class MeshSolver:
         grid = sharded_grid_solver(mesh, n_iter, n_f32)
 
         def staged(prob, thetas, delta_mask):
-            V, conv, grad, u0, z = grid(prob, thetas, delta_mask)
+            V, conv, feas, grad, u0, z = grid(prob, thetas, delta_mask)
             Vstar, dstar = reduce_deltas(V, conv)
-            return V, conv, grad, u0, z, Vstar, dstar
+            return V, conv, feas, grad, u0, z, Vstar, dstar
 
         if self.multiprocess:
             # Every process runs the frontier in deterministic lockstep
@@ -148,7 +149,7 @@ class MeshSolver:
             # the all-gather over ICI/DCN) so np.asarray works on each
             # process without application-level messaging.
             rep = NamedSharding(mesh, P())
-            self._fn = jax.jit(staged, out_shardings=(rep,) * 7)
+            self._fn = jax.jit(staged, out_shardings=(rep,) * 8)
         else:
             self._fn = jax.jit(staged)
 
@@ -169,6 +170,7 @@ class MeshSolver:
         staged_in = distributed.stage_batch(self._batch_sharding, xpad)
         out = self._fn(self.prob, staged_in, self.delta_mask)
         # Unpad points and (for per-delta outputs) padded commutations.
-        V, conv, grad, u0, z, Vstar, dstar = out
-        return (V[:Pn, :self.nd], conv[:Pn, :self.nd], grad[:Pn, :self.nd],
+        V, conv, feas, grad, u0, z, Vstar, dstar = out
+        return (V[:Pn, :self.nd], conv[:Pn, :self.nd],
+                feas[:Pn, :self.nd], grad[:Pn, :self.nd],
                 u0[:Pn, :self.nd], z[:Pn, :self.nd], Vstar[:Pn], dstar[:Pn])

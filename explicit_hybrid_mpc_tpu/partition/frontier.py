@@ -162,7 +162,9 @@ class FrontierEngine:
                 # fallback's results drift from the main oracle's.
                 n_f32=(self.oracle.n_f32
                        if self.oracle.precision == "mixed" else None),
-                points_cap=self.oracle.points_cap)
+                points_cap=self.oracle.points_cap,
+                rescue_iter=self.oracle.rescue_iter,
+                point_schedule=self.oracle.point_schedule)
         return self._fb_oracle
 
     def _oracle_call(self, method: str, *args):
@@ -186,11 +188,13 @@ class FrontierEngine:
             self.log.emit(device_failure=repr(e)[:500], query=method,
                           retry_backend="cpu")
             fb = self._fallback_oracle()
-            before = (fb.n_solves, fb.n_point_solves, fb.n_simplex_solves)
+            before = (fb.n_solves, fb.n_point_solves, fb.n_simplex_solves,
+                      fb.n_rescue_solves)
             out = getattr(fb, method)(*args)
             self.oracle.n_solves += fb.n_solves - before[0]
             self.oracle.n_point_solves += fb.n_point_solves - before[1]
             self.oracle.n_simplex_solves += fb.n_simplex_solves - before[2]
+            self.oracle.n_rescue_solves += fb.n_rescue_solves - before[3]
             return out
         finally:
             self._oracle_s += time.perf_counter() - t0
@@ -584,6 +588,7 @@ class FrontierEngine:
             # that, and `inherited_skips` counts the solves it avoided.
             "point_solves": self.oracle.n_point_solves,
             "simplex_solves": self.oracle.n_simplex_solves,
+            "rescue_solves": self.oracle.n_rescue_solves,
             "inherited_skips": self.n_inherited_skips,
             "uncertified": self.n_uncertified,
             # Non-empty frontier here means the run hit max_steps: the
@@ -629,6 +634,7 @@ class FrontierEngine:
                 "n_solves": self.oracle.n_solves,
                 "n_point_solves": self.oracle.n_point_solves,
                 "n_simplex_solves": self.oracle.n_simplex_solves,
+                "n_rescue_solves": self.oracle.n_rescue_solves,
                 # Inherited per-delta bounds are part of frontier state:
                 # dropping them on resume would be sound (they are an
                 # optimization) but would break resumed-equals-straight
@@ -682,6 +688,7 @@ class FrontierEngine:
         oracle.n_solves = snap.get("n_solves", 0)
         oracle.n_point_solves = snap.get("n_point_solves", 0)
         oracle.n_simplex_solves = snap.get("n_simplex_solves", 0)
+        oracle.n_rescue_solves = snap.get("n_rescue_solves", 0)
         # Rebuild the open-simplex refcounts from the restored frontier and
         # drop cache rows no open simplex references (the snapshot may
         # predate their eviction).
@@ -697,7 +704,9 @@ class FrontierEngine:
 def build_partition(problem, cfg: PartitionConfig,
                     oracle: Oracle | None = None) -> PartitionResult:
     """One-call offline build: problem + config -> certified partition."""
-    oracle = oracle or Oracle(problem, backend=cfg.backend,
-                              precision=cfg.precision)
+    oracle = oracle or Oracle(
+        problem, backend=cfg.backend, precision=cfg.precision,
+        point_schedule=getattr(cfg, "ipm_point_schedule", None),
+        rescue_iter=getattr(cfg, "ipm_rescue_iters", 0))
     log = RunLog(cfg.log_path, echo=False)
     return FrontierEngine(problem, oracle, cfg, log).run()

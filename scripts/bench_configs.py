@@ -100,7 +100,7 @@ def main() -> int:
                                        time_budget_s=120.0,
                                        precision=precision)
             build_partition(problem, warm_cfg, oracle=oracle)
-            oracle.n_solves = oracle.n_point_solves = 0
+            oracle.n_solves = oracle.n_point_solves = oracle.n_rescue_solves = 0
             oracle.n_simplex_solves = 0
 
             cfg = PartitionConfig(problem=name, eps_a=eps_a, eps_r=eps_r,

@@ -73,6 +73,7 @@ def run(result: dict) -> None:
                                precision=precision)
     build_partition(problem, warm_cfg, oracle=oracle)
     oracle.n_solves = oracle.n_point_solves = oracle.n_simplex_solves = 0
+    oracle.n_rescue_solves = 0
 
     log(f"flagship build (eps_a=1e-2, budget {budget:.0f}s)...")
     # Per-step JSONL (device_frac = the SURVEY 6.5 utilization proxy)

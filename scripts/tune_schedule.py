@@ -31,8 +31,35 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from bench import choose_backend, log, retry_transient  # noqa: E402
 
-# (n_f32, n_f64) candidates; (20, 10) is the shipping default.
-SCHEDULES = [(20, 10), (20, 6), (20, 4), (24, 4), (16, 6), (0, 30)]
+# Schedule candidates; the first entry is the shipping default.
+# n_f32/n_f64 set the SIMPLEX-class (joint QP) schedule; "point"
+# optionally overrides the POINT-class schedule (r3 finding: point QPs
+# converge in ~12-16 total iterations, the joint QPs need the full
+# schedule), and "rescue" enables the full-length cold-f64 re-solve of
+# feasible-but-unconverged point stragglers that makes an aggressive
+# point schedule safe (Oracle(rescue_iter=...)).
+SCHEDULES = [
+    {"n_f32": 20, "n_f64": 10},
+    {"n_f32": 20, "n_f64": 6},
+    {"n_f32": 16, "n_f64": 6},
+    {"n_f32": 0, "n_f64": 30},
+    {"n_f32": 20, "n_f64": 10, "point": (16, 4), "rescue": 30},
+    {"n_f32": 20, "n_f64": 10, "point": (12, 4), "rescue": 30},
+    {"n_f32": 20, "n_f64": 10, "point": (8, 4), "rescue": 30},
+]
+
+
+def _make_oracle(problem, backend, sched, points_cap):
+    from explicit_hybrid_mpc_tpu.oracle.oracle import Oracle
+
+    n_f32, n_f64 = sched["n_f32"], sched["n_f64"]
+    precision = "f64" if n_f32 == 0 else "mixed"
+    return Oracle(problem, backend=backend, n_iter=n_f32 + n_f64,
+                  precision=precision,
+                  n_f32=n_f32 if precision == "mixed" else None,
+                  point_schedule=sched.get("point"),
+                  rescue_iter=sched.get("rescue", 0),
+                  points_cap=points_cap)
 
 
 def run(result: dict) -> None:
@@ -74,22 +101,28 @@ def run(result: dict) -> None:
     dev_backend = "device" if on_acc else "cpu"
     rows = []
     result["schedules"] = rows
-    for n_f32, n_f64 in SCHEDULES:
-        precision = "f64" if n_f32 == 0 else "mixed"
-        orc = Oracle(problem, backend=dev_backend,
-                     n_iter=n_f32 + n_f64, precision=precision,
-                     n_f32=n_f32 if precision == "mixed" else None,
-                     points_cap=2048 if on_acc else 256)
-        row = {"n_f32": n_f32, "n_f64": n_f64}
+    for sched in SCHEDULES:
+        n_f32, n_f64 = sched["n_f32"], sched["n_f64"]
+        orc = _make_oracle(problem, dev_backend, sched,
+                           2048 if on_acc else 256)
+        row = dict(sched)
+        if "point" in row:
+            row["point"] = list(row["point"])
         try:
             retry_transient(lambda: orc.solve_vertices(thetas),
                             what=f"warm {n_f32}+{n_f64}")  # compile only
+            orc.n_rescue_solves = 0  # warm call's rescues don't count
             t0 = time.perf_counter()
             sol = orc.solve_vertices(thetas)
             dt = time.perf_counter() - t0
             conv = np.asarray(sol.conv)
             row["point_us_per_qp"] = round(dt / (n_points * nd) * 1e6, 3)
             row["converged_frac"] = round(float(conv.mean()), 5)
+            # Fraction of point QPs the rescue pass re-solved (0 unless
+            # "rescue" is set); the aggressive point schedules are only
+            # wins while this stays small.
+            row["rescue_frac"] = round(
+                orc.n_rescue_solves / (n_points * nd), 5)
             # Simplex-min batch (the structurally larger joint QP).
             retry_transient(lambda: orc.solve_simplex_min(Ms, ds64),
                             what=f"simplex warm {n_f32}+{n_f64}")
@@ -109,10 +142,10 @@ def run(result: dict) -> None:
         rows.append(row)
 
     # conv_ok is judged against the DEFAULT schedule's measured baseline
-    # (by identity, not list position: if the default row itself errored,
-    # tuning is meaningless this capture and parity is skipped).
-    default_row = next((r for r in rows
-                        if (r["n_f32"], r["n_f64"]) == SCHEDULES[0]), None)
+    # (rows append in SCHEDULES order, so rows[0] is the default; if that
+    # row errored, tuning is meaningless this capture and parity is
+    # skipped).
+    default_row = rows[0] if rows else None
     if default_row is None or "error" in default_row:
         result["note"] = "default schedule row failed; no recommendation"
         return
@@ -122,23 +155,27 @@ def run(result: dict) -> None:
             r["conv_ok"] = r["converged_frac"] >= base_conv - 1e-3
 
     # Parity builds: default schedule vs the fastest conv_ok candidate.
-    candidates = [r for r in rows if r.get("conv_ok") and "error" not in r
-                  and (r["n_f32"], r["n_f64"]) != SCHEDULES[0]]
+    candidates = [r for r in rows[1:]
+                  if r.get("conv_ok") and "error" not in r]
     if candidates:
         fastest = min(candidates, key=lambda r: r["point_us_per_qp"])
         counts = {}
-        for tag, (nf, npol) in (("default", SCHEDULES[0]),
-                                ("fastest", (fastest["n_f32"],
-                                             fastest["n_f64"]))):
-            orc = Oracle(problem, backend=dev_backend, n_iter=nf + npol,
-                         precision="mixed", n_f32=nf,
-                         points_cap=2048 if on_acc else 256)
+        for tag, sched in (("default", SCHEDULES[0]),
+                           ("fastest", {k: fastest[k]
+                                        for k in ("n_f32", "n_f64",
+                                                  "point", "rescue")
+                                        if k in fastest})):
+            if "point" in sched:
+                sched = dict(sched, point=tuple(sched["point"]))
+            orc = _make_oracle(problem, dev_backend, sched,
+                               2048 if on_acc else 256)
             cfg = PartitionConfig(problem=problem_name, eps_a=eps_a,
                                   backend="device", batch_simplices=256,
                                   max_steps=50_000, precision="mixed",
                                   time_budget_s=build_budget)
             res = build_partition(problem, cfg, oracle=orc)
-            counts[tag] = {"schedule": [nf, npol],
+            counts[tag] = {"schedule": dict(sched, point=list(
+                               sched.get("point", ())) or None),
                            "regions": res.stats["regions"],
                            "tree_nodes": res.stats["tree_nodes"],
                            "truncated": res.stats["truncated"],

@@ -93,3 +93,37 @@ def test_online_crossover_smoke(tmp_path):
         assert row["leaves"] > 0
         assert row["jax_us"] > 0 and row["descent_us"] > 0
         assert "pallas_us" not in row  # Mosaic timing is TPU-only
+
+
+def test_tune_schedule_smoke(tmp_path):
+    out = str(tmp_path / "tune_schedule.json")
+    data = _run("scripts/tune_schedule.py", {
+        "TUNE_OUT": out,
+        "TUNE_POINTS": "16",
+        "TUNE_EPS": "0.5",
+        "TUNE_BUILD_BUDGET": "20",
+        "TUNE_PROBLEM": "double_integrator",
+    }, out, timeout=560)
+    assert data["platform"] == "cpu"
+    rows = data["schedules"]
+    assert len(rows) >= 4
+    # Every schedule row (incl. the split point-schedule + rescue ones)
+    # must produce timing + convergence + rescue-fraction fields.
+    for r in rows:
+        assert "error" not in r, r
+        assert r["point_us_per_qp"] > 0
+        assert 0.0 <= r["converged_frac"] <= 1.0
+        assert 0.0 <= r["rescue_frac"] <= 1.0
+
+
+def test_profile_capture_smoke(tmp_path):
+    out = str(tmp_path / "profile.json")
+    data = _run("scripts/profile_capture.py", {
+        "PROFILE_OUT": out,
+        "PROFILE_TRACE_DIR": str(tmp_path / "trace"),
+        "PROFILE_PROBLEM": "double_integrator",
+        "PROFILE_EPS": "0.5",
+        "PROFILE_STEPS": "2",
+        "PROFILE_TIME_BUDGET": "60",
+    }, out, timeout=420)
+    assert data["platform"] == "cpu"

@@ -185,3 +185,28 @@ def test_selective_phase1_skips_feasible_pairs(oracle, rng):
     assert np.all(feas)            # di is feasible everywhere in the box
     assert np.all(np.isfinite(Vmin))
     assert issued < 2 * 8          # the old cost was exactly 2 per pair
+
+
+def test_rescue_recovers_short_point_schedule():
+    """An aggressive point schedule plus rescue must recover the full
+    schedule's converged set: rescue re-solves exactly the
+    feasible-but-unconverged stragglers cold at full f64 length."""
+    prob = make("inverted_pendulum", N=3)
+    rng = np.random.default_rng(5)
+    thetas = rng.uniform(prob.theta_lb, prob.theta_ub, size=(24, 2))
+    base = Oracle(prob, backend="cpu", n_iter=30)
+    short = Oracle(prob, backend="cpu", n_iter=30, precision="mixed",
+                   n_f32=20, point_schedule=(8, 4))
+    resc = Oracle(prob, backend="cpu", n_iter=30, precision="mixed",
+                  n_f32=20, point_schedule=(8, 4), rescue_iter=30)
+    sb, ss, sr = (o.solve_vertices(thetas) for o in (base, short, resc))
+    # The short schedule must actually lose some cells for this test to
+    # exercise anything; the rescue pass then restores them.
+    assert ss.conv.sum() < sb.conv.sum()
+    assert resc.n_rescue_solves > 0
+    assert sr.conv.sum() >= sb.conv.sum()
+    # Rescued values agree with the full-schedule solve (mask BEFORE the
+    # subtraction: unconverged cells hold +inf and inf - inf warns).
+    both = sb.conv & sr.conv
+    assert np.allclose(sr.V[both], sb.V[both], atol=1e-6)
+    np.testing.assert_array_equal(sr.dstar, sb.dstar)

@@ -52,7 +52,7 @@ def test_sharded_matches_dense(mesh_shape):
     solver = MeshSolver(prob, mesh, n_iter=30)
     sharded = solver(thetas)
 
-    names = ("V", "conv", "grad", "u0", "z", "Vstar", "dstar")
+    names = ("V", "conv", "feas", "grad", "u0", "z", "Vstar", "dstar")
     for name, a, b in zip(names, dense, sharded):
         a, b = np.asarray(a), np.asarray(b)
         if a.dtype == bool or np.issubdtype(a.dtype, np.integer):
@@ -73,8 +73,8 @@ def test_delta_padding_mesh():
     dense = omod._solve_points_all_deltas(prob, jax.numpy.asarray(thetas), 30)
     solver = MeshSolver(prob, make_mesh((4, 2)), n_iter=30)
     sharded = solver(thetas)
-    np.testing.assert_array_equal(np.asarray(dense[6]), sharded[6])  # dstar
-    a, b = np.asarray(dense[5]), np.asarray(sharded[5])              # Vstar
+    np.testing.assert_array_equal(np.asarray(dense[7]), sharded[7])  # dstar
+    a, b = np.asarray(dense[6]), np.asarray(sharded[6])              # Vstar
     np.testing.assert_allclose(a[np.isfinite(a)], b[np.isfinite(b)],
                                rtol=1e-9)
     assert sharded[0].shape == (8, 3)  # delta padding removed

@@ -77,6 +77,12 @@ class PartitionConfig:
     # Full-length cold-f64 re-solve of feasible-but-unconverged point
     # solves (0 disables).  See Oracle(rescue_iter=...).
     ipm_rescue_iters: int = 0
+    # Dispatch the next frontier batch's point solves while the host
+    # certifies the current batch (jax async dispatch; results consumed
+    # next step).  Deterministic: the prefetched plan is exactly the plan
+    # the next step would compute.  False forces the strictly-synchronous
+    # solve -> certify -> solve loop.
+    prefetch_solves: bool = True
     # Inherit per-commutation stage-2 facts (Farkas infeasibility
     # exclusions, simplex-min lower bounds) from parent to children across
     # bisections.  Certified-exact decision parity with the uninherited

@@ -158,6 +158,7 @@ def main(argv: list[str] | None = None) -> int:
                                cfg.problem_args)
         for fld in ("problem", "problem_args", "eps_a", "eps_r",
                     "algorithm", "backend", "precision",
+                    "ipm_point_schedule", "ipm_rescue_iters",
                     "batch_simplices", "max_depth"):
             cli_v = getattr(cfg, fld)
             # default: pre-problem_args snapshots lack the field
@@ -182,8 +183,13 @@ def main(argv: list[str] | None = None) -> int:
         from explicit_hybrid_mpc_tpu.parallel import make_mesh
         mesh = make_mesh((args.mesh, 1))
     backend = "device" if cfg.backend == "tpu" else cfg.backend
+    # Solver schedule knobs come from the FINAL cfg too: resuming with a
+    # different schedule than the snapshot's would silently change conv
+    # patterns mid-build (resumed-equals-straight parity).
     oracle = Oracle(problem, backend=backend, mesh=mesh,
-                    precision=cfg.precision)
+                    precision=cfg.precision,
+                    point_schedule=getattr(cfg, "ipm_point_schedule", None),
+                    rescue_iter=getattr(cfg, "ipm_rescue_iters", 0))
     log = RunLog(cfg.log_path, echo=True)
     if args.resume:
         eng = FrontierEngine.resume(snapshot, problem, oracle, log, cfg=cfg)

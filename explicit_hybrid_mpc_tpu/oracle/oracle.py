@@ -463,42 +463,73 @@ class Oracle:
         """Solve the full enumeration at each point; pads the point batch
         to power-of-two buckets (bounded by max_points_per_call, larger
         batches are chunked) so jit caches stay warm and small."""
+        return self.wait_vertices(self.dispatch_vertices(thetas))
+
+    def dispatch_vertices(self, thetas: np.ndarray):
+        """Issue the device programs for a vertex-grid solve WITHOUT
+        blocking on the results (jax dispatch is asynchronous; conversion
+        to numpy is what blocks).  Returns an opaque handle for
+        wait_vertices.  The frontier engine uses the split to overlap the
+        next batch's point solves with the current batch's host-side
+        certification.  The serial backend solves eagerly (its contract
+        is one blocking QP at a time -- there is nothing to overlap)."""
         thetas = np.atleast_2d(np.asarray(thetas, dtype=np.float64))
         P = thetas.shape[0]
-        nd = self.can.n_delta
-        nt, nu, nz = self.can.n_theta, self.can.n_u, self.can.nz
         if P == 0:
+            return ("empty",)
+        # Solve counters increment at WAIT time, not here: a dispatched-
+        # but-never-consumed prefetch (end-of-budget, or in-flight at a
+        # checkpoint) must not make a resumed build's solve counts
+        # diverge from a straight run's.
+        if self.backend == "serial":
+            outs = [self._solve_one_point(self.prob, jnp.asarray(t))
+                    for t in thetas]
+            parts = [np.concatenate([np.asarray(o[k]) for o in outs])
+                     for k in range(8)]
+            return ("parts", thetas, parts)
+        cap = self.max_points_per_call
+        chunks = []
+        for lo in range(0, P, cap):
+            chunk = thetas[lo:lo + cap]
+            Pc = chunk.shape[0]
+            if self._mesh_solver is not None:
+                # MeshSolver returns lazily-sliced device arrays.
+                chunks.append((self._mesh_solver(chunk), Pc, False))
+                continue
+            Ppad = min(cap, max(8, 1 << (Pc - 1).bit_length()))
+            pad = np.zeros((Ppad - Pc, thetas.shape[1]))
+            out = self._solve_points(self.prob, jnp.asarray(
+                np.concatenate([chunk, pad])))
+            chunks.append((out, Pc, True))
+        return ("chunks", thetas, chunks)
+
+    def wait_vertices(self, handle) -> VertexSolution:
+        """Block on a dispatch_vertices handle: device->host transfer,
+        rescue pass, finalization."""
+        kind = handle[0]
+        if kind == "empty":
+            nd = self.can.n_delta
+            nt, nu, nz = self.can.n_theta, self.can.n_u, self.can.nz
             return VertexSolution(
                 V=np.zeros((0, nd)), conv=np.zeros((0, nd), dtype=bool),
                 feas=np.zeros((0, nd), dtype=bool),
                 grad=np.zeros((0, nd, nt)), u0=np.zeros((0, nd, nu)),
                 z=np.zeros((0, nd, nz)), Vstar=np.zeros(0),
                 dstar=np.zeros(0, dtype=np.int64))
-        self.n_solves += P * nd
-        self.n_point_solves += P * nd
-        if self.backend == "serial":
-            outs = [self._solve_one_point(self.prob, jnp.asarray(t))
-                    for t in thetas]
-            parts = [np.concatenate([np.asarray(o[k]) for o in outs])
-                     for k in range(8)]
+        if kind == "parts":
+            _, thetas, parts = handle
         else:
-            cap = self.max_points_per_call
-            chunks = []
-            for lo in range(0, P, cap):
-                chunk = thetas[lo:lo + cap]
-                Pc = chunk.shape[0]
-                if self._mesh_solver is not None:
-                    out = self._mesh_solver(chunk)
-                    chunks.append([np.asarray(o) for o in out])
-                    continue
-                Ppad = min(cap, max(8, 1 << (Pc - 1).bit_length()))
-                pad = np.zeros((Ppad - Pc, thetas.shape[1]))
-                out = self._solve_points(self.prob, jnp.asarray(
-                    np.concatenate([chunk, pad])))
-                chunks.append([np.asarray(o)[:Pc] for o in out])
-            parts = [np.concatenate([c[k] for c in chunks])
-                     for k in range(8)]
+            _, thetas, chunks = handle
+            parts = [np.concatenate(
+                [np.asarray(out[k])[:Pc] if padded else
+                 np.asarray(out[k]) for out, Pc, padded in chunks])
+                for k in range(8)]
         self._rescue_grid(thetas, parts)
+        # Counters last: if the transfer or the rescue raised, the caller
+        # reroutes the WHOLE batch to the CPU fallback, whose own counts
+        # are folded in -- counting here first would double-count it.
+        self.n_solves += thetas.shape[0] * self.can.n_delta
+        self.n_point_solves += thetas.shape[0] * self.can.n_delta
         return VertexSolution(*self._finalize(parts))
 
     def _rescue_grid(self, thetas: np.ndarray, parts: list) -> None:
@@ -708,29 +739,44 @@ class Oracle:
         z (K, nz)); V is +inf where unconverged, matching
         solve_vertices' encoding.
         """
+        return self.wait_pairs(self.dispatch_pairs(thetas, delta_idx))
+
+    def dispatch_pairs(self, thetas: np.ndarray, delta_idx: np.ndarray):
+        """Non-blocking counterpart of solve_pairs (see
+        dispatch_vertices)."""
         thetas = np.atleast_2d(np.asarray(thetas, dtype=np.float64))
         K = thetas.shape[0]
-        nt, nu, nz = self.can.n_theta, self.can.n_u, self.can.nz
         if K == 0:
-            return (np.zeros(0), np.zeros(0, dtype=bool), np.zeros((0, nt)),
-                    np.zeros((0, nu)), np.zeros((0, nz)))
+            return ("empty",)
         delta_idx = np.asarray(delta_idx, dtype=np.int64)
-        self.n_solves += K
-        self.n_point_solves += K
+        # Counters increment at wait time (see dispatch_vertices).
         if self.backend == "serial":
             outs = [self._solve_pair_one(jnp.asarray(t), int(d))
                     for t, d in zip(thetas, delta_idx)]
             parts = [np.stack([np.asarray(o[k]) for o in outs])
                      for k in range(6)]
+            return ("parts", thetas, delta_idx, parts)
+        cap = self.max_pairs_per_call
+        chunks = []
+        for lo in range(0, K, cap):
+            tj, dj, Kc = self._pad_pairs(thetas[lo:lo + cap],
+                                         delta_idx[lo:lo + cap])
+            chunks.append((self._solve_fixed(tj, dj), Kc))
+        return ("chunks", thetas, delta_idx, chunks)
+
+    def wait_pairs(self, handle):
+        """Block on a dispatch_pairs handle: transfer, rescue, finalize."""
+        kind = handle[0]
+        if kind == "empty":
+            nt, nu, nz = self.can.n_theta, self.can.n_u, self.can.nz
+            return (np.zeros(0), np.zeros(0, dtype=bool), np.zeros((0, nt)),
+                    np.zeros((0, nu)), np.zeros((0, nz)))
+        if kind == "parts":
+            _, thetas, delta_idx, parts = handle
         else:
-            cap = self.max_pairs_per_call
-            chunks = []
-            for lo in range(0, K, cap):
-                tj, dj, Kc = self._pad_pairs(thetas[lo:lo + cap],
-                                             delta_idx[lo:lo + cap])
-                out = self._solve_fixed(tj, dj)
-                chunks.append([np.asarray(o)[:Kc] for o in out])
-            parts = [np.concatenate([c[k] for c in chunks])
+            _, thetas, delta_idx, chunks = handle
+            parts = [np.concatenate([np.asarray(out[k])[:Kc]
+                                     for out, Kc in chunks])
                      for k in range(6)]
         V, conv, feas, grad, u0, z = parts
         conv, feas = conv.astype(bool), feas.astype(bool)
@@ -740,6 +786,9 @@ class Oracle:
                 thetas[idx], delta_idx[idx])
             V[idx], conv[idx], grad[idx] = rV, rconv, rgrad
             u0[idx], z[idx] = ru0, rz
+        # Counters last (see wait_vertices).
+        self.n_solves += thetas.shape[0]
+        self.n_point_solves += thetas.shape[0]
         return np.where(conv, V, _INF), conv, grad, u0, z
 
     # -- fixed-commutation point solve (the semi-explicit ONLINE stage) ----

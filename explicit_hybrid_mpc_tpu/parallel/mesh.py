@@ -110,8 +110,9 @@ def sharded_grid_solver(mesh: Mesh, n_iter: int, n_f32: int = 0):
 class MeshSolver:
     """Host-facing wrapper: pads/stages inputs, unpads outputs.
 
-    Drop-in for the dense path in Oracle.solve_vertices: same 7-tuple
-    contract, but the work is sharded over `mesh`.
+    Drop-in for the dense path in Oracle.solve_vertices: same 8-tuple
+    contract (V, conv, feas, grad, u0, z, Vstar, dstar), but the work is
+    sharded over `mesh`.
     """
 
     def __init__(self, prob: DeviceProblem, mesh: Mesh, n_iter: int = 30,

@@ -111,6 +111,10 @@ class FrontierEngine:
         self.n_unique_solves = 0
         self.n_device_failures = 0
         self.n_point_skips = 0
+        self.n_prefetched_steps = 0
+        # In-flight prefetched solve for the next batch:
+        # (nodes tuple, plan, grid handle, pair handle) or None.
+        self._prefetch = None
         # Interned all-True active-delta mask (shared by every full cache
         # row; never mutated -- partial masks are fresh copies).
         self._full_mask = np.ones(oracle.can.n_delta, dtype=bool)
@@ -215,8 +219,8 @@ class FrontierEngine:
 
     # -- vertex solves -----------------------------------------------------
 
-    def _solve_missing(self, nodes: list[int]) -> None:
-        """Solve every (vertex, commutation) cell the certificates of
+    def _plan_missing(self, nodes: list[int]) -> dict | None:
+        """Decide every (vertex, commutation) cell the certificates of
         `nodes` can read but the cache does not hold.
 
         Masked path (cfg.mask_point_solves): a commutation Farkas-excluded
@@ -231,7 +235,13 @@ class FrontierEngine:
         later node needs commutations an earlier requester excluded.
         Fabricated cells (V=+inf, conv=False) encode exactly what the
         skipped solve would have returned for an infeasible QP, so the
-        build is tree-identical to the unmasked one."""
+        build is tree-identical to the unmasked one.
+
+        Returns a plan dict for _dispatch_plan/_consume_plan, or None if
+        the cache already holds everything.  Planning only reads state
+        that is stable between frontier steps (cache rows, inherited
+        exclusions of OPEN nodes), which is what makes prefetch planning
+        at the end of step k valid for step k+1."""
         nd = self.oracle.can.n_delta
         full = self._full_mask
         use_mask = (nd > 1 and self.oracle.mesh is None
@@ -261,6 +271,7 @@ class FrontierEngine:
         pair_d: list[int] = []
         # (key, delta indices, offset into the pair batch)
         pair_slices: list[tuple[bytes, np.ndarray, int]] = []
+        n_skips = n_new = 0
         for k, m in need.items():
             row = self.cache.get_key(k)
             if row is None:
@@ -269,7 +280,7 @@ class FrontierEngine:
                     grid_keys.append(k)
                     continue
                 missing_d = m
-                self.n_point_skips += int(nd - m.sum())
+                n_skips += int(nd - m.sum())
             else:
                 missing_d = m & ~row[7]
                 if not missing_d.any():
@@ -280,52 +291,123 @@ class FrontierEngine:
                 # already counted -- n_unique_solves stays a count of
                 # distinct vertices ever solved, same meaning as the
                 # unmasked build's.
-                self.n_unique_solves += 1
+                n_new += 1
             pair_slices.append((k, ds, len(pair_d)))
             pair_t.extend([vert[k]] * ds.size)
             pair_d.extend(ds.tolist())
-        self.n_unique_solves += len(grid_pts)
-        if grid_pts:
-            sol: VertexSolution = self._oracle_call(
-                "solve_vertices", np.stack(grid_pts))
-            for i, k in enumerate(grid_keys):
-                self.cache.put_key(k, (sol.V[i], sol.conv[i], sol.grad[i],
-                                       sol.u0[i], sol.z[i], sol.Vstar[i],
-                                       sol.dstar[i], full))
-        if pair_slices:
-            V, conv, grad, u0, z = self._oracle_call(
-                "solve_pairs", np.stack(pair_t),
-                np.asarray(pair_d, dtype=np.int64))
-            nt, nu, nz = (self.problem.n_theta, self.problem.n_u,
-                          self.oracle.can.nz)
-            for k, ds, lo in pair_slices:
-                row = self.cache.get_key(k)
-                if row is None:
-                    Vr = np.full(nd, np.inf)
-                    convr = np.zeros(nd, dtype=bool)
-                    gradr = np.zeros((nd, nt))
-                    u0r = np.zeros((nd, nu))
-                    zr = np.zeros((nd, nz))
-                    maskr = np.zeros(nd, dtype=bool)
-                else:
-                    Vr, convr, gradr = (row[0].copy(), row[1].copy(),
-                                        row[2].copy())
-                    u0r, zr = row[3].copy(), row[4].copy()
-                    maskr = row[7].copy()
-                sl = slice(lo, lo + ds.size)
-                Vr[ds], convr[ds], gradr[ds] = V[sl], conv[sl], grad[sl]
-                u0r[ds], zr[ds] = u0[sl], z[sl]
-                maskr[ds] = True
-                # Same reduction as oracle.reduce_deltas (first minimum):
-                # skipped cells are +inf/unconverged, so the subset argmin
-                # equals the full-grid argmin.
-                Vval = np.where(convr, Vr, np.inf)
-                j = int(np.argmin(Vval))
-                Vs = Vval[j]
-                self.cache.put_key(k, (Vr, convr, gradr, u0r, zr, Vs,
-                                       np.int64(j if np.isfinite(Vs)
-                                                else -1),
-                                       full if maskr.all() else maskr))
+        if not grid_pts and not pair_slices:
+            return None
+        return {"grid_pts": grid_pts, "grid_keys": grid_keys,
+                "pair_t": pair_t, "pair_d": pair_d,
+                "pair_slices": pair_slices,
+                "n_skips": n_skips, "n_new": n_new + len(grid_pts)}
+
+    def _dispatch_plan(self, plan: dict | None) -> tuple:
+        """Issue the plan's device programs without blocking (jax async
+        dispatch).  A dispatch-time device error is recorded in the
+        handle; _consume_plan reroutes that part to the CPU fallback."""
+        if plan is None:
+            return (None, None)
+        gh = ph = None
+        t0 = time.perf_counter()
+        try:
+            if plan["grid_pts"]:
+                gh = self.oracle.dispatch_vertices(
+                    np.stack(plan["grid_pts"]))
+            if plan["pair_slices"]:
+                ph = self.oracle.dispatch_pairs(
+                    np.stack(plan["pair_t"]),
+                    np.asarray(plan["pair_d"], dtype=np.int64))
+        except (RuntimeError, OSError) as e:
+            # Mark BOTH parts failed: a raising tunnel rarely delivers
+            # the part that did not raise, and the fallback recomputes
+            # deterministically either way.
+            gh = ph = ("failed", e)
+        finally:
+            self._oracle_s += time.perf_counter() - t0
+        return (gh, ph)
+
+    def _consume_plan(self, plan: dict | None, gh, ph) -> None:
+        """Block on the dispatched programs and write the cache rows.
+        Device failures (at dispatch or while transferring) retry the
+        SAME deterministic batch on the CPU fallback oracle, preserving
+        build parity (SURVEY.md section 6.3)."""
+        if plan is None:
+            return
+        nd = self.oracle.can.n_delta
+        full = self._full_mask
+        self.n_unique_solves += plan["n_new"]
+        self.n_point_skips += plan["n_skips"]
+        t0 = time.perf_counter()
+        try:
+            if plan["grid_pts"]:
+                sol: VertexSolution = self._wait_or_fallback(
+                    "vertices", gh, (np.stack(plan["grid_pts"]),))
+                for i, k in enumerate(plan["grid_keys"]):
+                    self.cache.put_key(
+                        k, (sol.V[i], sol.conv[i], sol.grad[i], sol.u0[i],
+                            sol.z[i], sol.Vstar[i], sol.dstar[i], full))
+            if plan["pair_slices"]:
+                V, conv, grad, u0, z = self._wait_or_fallback(
+                    "pairs", ph,
+                    (np.stack(plan["pair_t"]),
+                     np.asarray(plan["pair_d"], dtype=np.int64)))
+                nt, nu, nz = (self.problem.n_theta, self.problem.n_u,
+                              self.oracle.can.nz)
+                for k, ds, lo in plan["pair_slices"]:
+                    row = self.cache.get_key(k)
+                    if row is None:
+                        Vr = np.full(nd, np.inf)
+                        convr = np.zeros(nd, dtype=bool)
+                        gradr = np.zeros((nd, nt))
+                        u0r = np.zeros((nd, nu))
+                        zr = np.zeros((nd, nz))
+                        maskr = np.zeros(nd, dtype=bool)
+                    else:
+                        Vr, convr, gradr = (row[0].copy(), row[1].copy(),
+                                            row[2].copy())
+                        u0r, zr = row[3].copy(), row[4].copy()
+                        maskr = row[7].copy()
+                    sl = slice(lo, lo + ds.size)
+                    Vr[ds], convr[ds], gradr[ds] = V[sl], conv[sl], grad[sl]
+                    u0r[ds], zr[ds] = u0[sl], z[sl]
+                    maskr[ds] = True
+                    # Same reduction as oracle.reduce_deltas (first
+                    # minimum): skipped cells are +inf/unconverged, so the
+                    # subset argmin equals the full-grid argmin.
+                    Vval = np.where(convr, Vr, np.inf)
+                    j = int(np.argmin(Vval))
+                    Vs = Vval[j]
+                    self.cache.put_key(k, (Vr, convr, gradr, u0r, zr, Vs,
+                                           np.int64(j if np.isfinite(Vs)
+                                                    else -1),
+                                           full if maskr.all() else maskr))
+        finally:
+            self._oracle_s += time.perf_counter() - t0
+
+    def _wait_or_fallback(self, kind: str, handle, args: tuple):
+        """Resolve one dispatched part; on device failure re-solve the
+        same batch synchronously on the CPU fallback oracle."""
+        try:
+            if isinstance(handle, tuple) and len(handle) == 2 \
+                    and handle[0] == "failed":
+                raise handle[1]
+            return (self.oracle.wait_vertices(handle) if kind == "vertices"
+                    else self.oracle.wait_pairs(handle))
+        except (RuntimeError, OSError) as e:
+            self.n_device_failures += 1
+            self.log.emit(device_failure=repr(e)[:500],
+                          query=f"dispatch_{kind}", retry_backend="cpu")
+            fb = self._fallback_oracle()
+            before = (fb.n_solves, fb.n_point_solves, fb.n_simplex_solves,
+                      fb.n_rescue_solves)
+            out = (fb.solve_vertices(*args) if kind == "vertices"
+                   else fb.solve_pairs(*args))
+            self.oracle.n_solves += fb.n_solves - before[0]
+            self.oracle.n_point_solves += fb.n_point_solves - before[1]
+            self.oracle.n_simplex_solves += fb.n_simplex_solves - before[2]
+            self.oracle.n_rescue_solves += fb.n_rescue_solves - before[3]
+            return out
 
     def _vertex_data(self, node: int) -> certify.SimplexVertexData:
         verts = self.tree.vertices[node]
@@ -348,7 +430,38 @@ class FrontierEngine:
         self._oracle_s = 0.0
         B = min(len(self.frontier), self.cfg.batch_simplices)
         nodes = [self.frontier.popleft() for _ in range(B)]
-        self._solve_missing(nodes)
+        pf = self._prefetch
+        self._prefetch = None
+        if pf is not None and pf[0] == tuple(nodes):
+            # This batch's point solves were dispatched DURING the
+            # previous step (before its consume), so the device worked
+            # through them while the host was waiting + certifying.
+            plan, gh, ph = pf[1], pf[2], pf[3]
+            self.n_prefetched_steps += 1
+        else:
+            plan = self._plan_missing(nodes)
+            gh, ph = self._dispatch_plan(plan)
+        # Prefetch the NEXT batch before blocking on this one.  Children
+        # append to the BACK of the deque, so whenever the remaining
+        # frontier already holds a full batch, the next batch is exactly
+        # its current prefix -- known now, before this step's splits.
+        # Planning against the pre-consume cache can re-solve a midpoint
+        # shared across the batch boundary (rare); the consume-time merge
+        # makes that a duplicate identical solve, not an inconsistency.
+        # Stage-2 solves queue behind the prefetched points on the
+        # device; latency moves around but the device never idles
+        # during host-side certification -- the throughput win.
+        if (getattr(self.cfg, "prefetch_solves", True)
+                and len(self.frontier) >= self.cfg.batch_simplices):
+            import itertools
+
+            nxt = list(itertools.islice(self.frontier, 0,
+                                        self.cfg.batch_simplices))
+            plan2 = self._plan_missing(nxt)
+            if plan2 is not None:
+                gh2, ph2 = self._dispatch_plan(plan2)
+                self._prefetch = (tuple(nxt), plan2, gh2, ph2)
+        self._consume_plan(plan, gh, ph)
 
         results: dict[int, certify.CertificateResult] = {}
         stage2: list[tuple[int, int]] = []  # (node, delta')
@@ -607,6 +720,9 @@ class FrontierEngine:
             # commutation was Farkas-excluded on an ancestor simplex
             # (cfg.mask_point_solves).
             "masked_point_skips": self.n_point_skips,
+            # Steps whose point solves were dispatched during the
+            # previous step's host work (cfg.prefetch_solves).
+            "prefetched_steps": self.n_prefetched_steps,
             "device_failures": self.n_device_failures,
             "cache_peak_vertices": self.cache.peak_vertices,
             "cache_peak_mb": round(self.cache.peak_bytes / 2**20, 2),
@@ -677,6 +793,8 @@ class FrontierEngine:
         eng._inherit = dict(snap.get("inherit", {}))
         eng.n_inherited_skips = snap.get("n_inherited_skips", 0)
         eng.n_point_skips = snap.get("n_point_skips", 0)
+        eng.n_prefetched_steps = 0
+        eng._prefetch = None
         eng._full_mask = np.ones(oracle.can.n_delta, dtype=bool)
         # Cache rows from pre-masking checkpoints lack the solved-delta
         # mask (8th element): every cell in them was actually solved.

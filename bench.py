@@ -25,7 +25,11 @@ numbers):
 Env knobs (all optional): BENCH_PLATFORM (force backend, skips the probe),
 BENCH_PROBLEM, BENCH_PRECISION, BENCH_EPS, BENCH_MAX_STEPS,
 BENCH_TIME_BUDGET (s), BENCH_DEADLINE (s, whole-script soft deadline),
-BENCH_PROBE_TIMEOUT (s), BENCH_BATCH, BENCH_POINTS_CAP.
+BENCH_PROBE_TIMEOUT (s), BENCH_BATCH, BENCH_POINTS_CAP,
+BENCH_POINT_SCHEDULE ("nf32,nf64" aggressive point-class IPM schedule),
+BENCH_RESCUE (straggler re-solve iterations; see Oracle.rescue_iter) --
+the last two apply to the batched AND serial oracles alike, so speedups
+keep isolating batching.
 
 Prints exactly ONE JSON line on stdout:
   {"metric": ..., "value": regions/sec, "unit": "regions/s",
@@ -144,6 +148,27 @@ def retry_transient(fn, attempts: int = 3, wait_s: float = 20.0,
             time.sleep(wait_s)
 
 
+def schedule_kwargs(result: dict | None = None) -> dict:
+    """Tuned-IPM-schedule env knobs, shared by bench and every capture
+    script so a tune_schedule.json recommendation can be applied fleet-
+    wide via environment: BENCH_POINT_SCHEDULE="nf32,nf64" (aggressive
+    point-class schedule) and BENCH_RESCUE="30" (straggler re-solve).
+    Unset = shipping defaults.  Records the knobs into `result`."""
+    kw = {}
+    ps = os.environ.get("BENCH_POINT_SCHEDULE")
+    if ps:
+        a, b = ps.split(",")
+        kw["point_schedule"] = (int(a), int(b))
+    r = os.environ.get("BENCH_RESCUE")
+    if r and int(r) > 0:
+        kw["rescue_iter"] = int(r)
+    if result is not None and kw:
+        result["schedule_overrides"] = {
+            k: list(v) if isinstance(v, tuple) else v
+            for k, v in kw.items()}
+    return kw
+
+
 def warm_oracle(oracle, problem, stop_after: float | None = None) -> None:
     """Compile every vertex-batch AND simplex-batch bucket up front so
     compile time stays out of the timed region.  Mid-run bucket compiles
@@ -258,8 +283,10 @@ def run(result: dict) -> None:
     # precision="mixed": f32 bulk + f64 polish to the same 1e-8 KKT
     # tolerance (TPU f64 is emulated ~10x slower); the serial baseline
     # below uses the SAME schedule, so the speedup isolates batching.
+    sched_kw = schedule_kwargs(result)
     oracle = Oracle(problem, backend="device" if on_acc else "cpu",
-                    precision=precision, points_cap=points_cap)
+                    precision=precision, points_cap=points_cap,
+                    **sched_kw)
     # Warm the jit caches so compile time is excluded: the bucket sweep,
     # then a tiny build for the simplex-query programs.
     warm_reserve = time_budget + 120.0  # leave room for build + baseline
@@ -289,7 +316,10 @@ def run(result: dict) -> None:
                   oracle_solves=stats["oracle_solves"],
                   point_solves=stats["point_solves"],
                   simplex_solves=stats["simplex_solves"],
+                  rescue_solves=stats["rescue_solves"],
                   inherited_skips=stats["inherited_skips"],
+                  masked_point_skips=stats["masked_point_skips"],
+                  prefetched_steps=stats["prefetched_steps"],
                   wall_s=round(stats["wall_s"], 2),
                   truncated=stats["truncated"],
                   # Batches that fell back to the CPU twin mid-build (a
@@ -303,7 +333,8 @@ def run(result: dict) -> None:
     # actually issued.
     from explicit_hybrid_mpc_tpu.partition import geometry
 
-    serial = Oracle(problem, backend="serial", precision=precision)
+    serial = Oracle(problem, backend="serial", precision=precision,
+                    **sched_kw)
     rng2 = np.random.default_rng(0)
     pts = rng2.uniform(problem.theta_lb, problem.theta_ub,
                        size=(8, problem.n_theta))

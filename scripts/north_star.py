@@ -64,8 +64,11 @@ def run(result: dict) -> None:
                                     "2048" if on_acc else "256"))
 
     # -- 1. flagship build -------------------------------------------------
+    from bench import schedule_kwargs
+    sched_kw = schedule_kwargs(result)
     oracle = Oracle(problem, backend="device" if on_acc else "cpu",
-                    precision=precision, points_cap=points_cap)
+                    precision=precision, points_cap=points_cap,
+                    **sched_kw)
     warm_oracle(oracle, problem)
     warm_cfg = PartitionConfig(problem=problem_name, eps_a=1.0,
                                backend="device", batch_simplices=512,
@@ -109,7 +112,8 @@ def run(result: dict) -> None:
     }
 
     # speedup vs measured serial per-solve latency
-    serial = Oracle(problem, backend="serial", precision=precision)
+    serial = Oracle(problem, backend="serial", precision=precision,
+                    **sched_kw)
     pts = np.random.default_rng(0).uniform(
         problem.theta_lb, problem.theta_ub, size=(8, problem.n_theta))
     serial.solve_vertices(pts[:2])
@@ -132,7 +136,7 @@ def run(result: dict) -> None:
                                batch_simplices=256, precision=precision,
                                time_budget_s=1800.0)
         orc = Oracle(problem, backend=backend, precision=precision,
-                     points_cap=points_cap)
+                     points_cap=points_cap, **sched_kw)
         pres = build_partition(problem, pcfg, oracle=orc)
         counts[backend] = {"regions": pres.stats["regions"],
                            "tree_nodes": pres.stats["tree_nodes"],

@@ -219,10 +219,12 @@ def warm_oracle(oracle, problem, stop_after: float | None = None) -> None:
             retry_transient(lambda: oracle._rescue_pairs(pts, ds),
                             what=f"rescue warmup {b}")
             b *= 2
-    # Simplex-query buckets.  solve_simplex_min warms the min-QP program;
-    # its phase-1 pass now runs only on suspect subsets, so the phase-1
-    # program is warmed explicitly via simplex_feasibility at every
-    # bucket (an unwarmed bucket is a ~minute mid-run tunnel compile).
+    # Simplex-query buckets: warm BOTH joint-QP programs directly at
+    # every bucket (an unwarmed bucket is a ~minute mid-run tunnel
+    # compile).  Going through solve_simplex_min would under-warm: each
+    # stage-2 order runs its second program only on a data-dependent
+    # subset, so e.g. the phase1-first default would never compile the
+    # elastic-min at a bucket whose warm rows all phase-1 as infeasible.
     from explicit_hybrid_mpc_tpu.partition import geometry
 
     span = problem.theta_ub - problem.theta_lb
@@ -237,9 +239,10 @@ def warm_oracle(oracle, problem, stop_after: float | None = None) -> None:
         log(f"warmup: simplex bucket {b}")
         Ms = np.tile(M1[None], (b, 1, 1))
         ds = (np.arange(b, dtype=np.int64) % nd)
-        retry_transient(lambda: oracle.solve_simplex_min(Ms, ds),
-                        what=f"simplex warmup {b}")
-        retry_transient(lambda: oracle.simplex_feasibility(Ms, ds),
+        Mj, dj = oracle._pad_simplex(Ms, ds)
+        retry_transient(lambda: oracle._simplex_min(Mj, dj),
+                        what=f"simplex-min warmup {b}")
+        retry_transient(lambda: oracle._simplex_feas(Mj, dj),
                         what=f"phase-1 warmup {b}")
         b *= 2
 

@@ -273,7 +273,8 @@ class Oracle:
                  points_cap: int | None = None,
                  n_f32: int | None = None,
                  rescue_iter: int = 0,
-                 point_schedule: tuple[int, int] | None = None):
+                 point_schedule: tuple[int, int] | None = None,
+                 stage2_order: str = "auto"):
         """mesh: optional jax.sharding.Mesh with ("batch", "delta") axes;
         when given, solve_vertices shards the (points x commutations) grid
         over it (parallel/mesh.py) instead of running on a single device --
@@ -365,6 +366,15 @@ class Oracle:
         # batch-composition-independent.
         self.rescue_iter = int(rescue_iter)
         self.n_rescue_solves = 0
+        # Stage-2 solve order (see solve_simplex_min): 'auto' = phase-1
+        # first on hybrid problems (pending pairs are overwhelmingly
+        # infeasible exclusions there), elastic-min first on
+        # single-commutation problems.
+        if stage2_order not in ("auto", "min_first", "phase1_first"):
+            raise ValueError(f"unknown stage2_order {stage2_order!r}")
+        self.stage2_phase1_first = (self.can.n_delta > 1
+                                    if stage2_order == "auto"
+                                    else stage2_order == "phase1_first")
         if backend in ("tpu", "gpu", "device"):
             platform = None  # default platform (the accelerator if present)
         elif backend in ("cpu", "serial"):
@@ -641,42 +651,80 @@ class Oracle:
         - -inf:   no usable bound (either solve stalled) -- conservatively
                   blocks certification, forcing a split.
 
-        The phase-1 + Farkas solve runs ONLY on pairs whose elastic min
-        came back with slack > tol or unconverged (the candidates for the
-        +inf upgrade): a converged elastic solve with t == 0 has exhibited
-        a hard-feasible point on R, so its phase-1 could never certify
-        infeasibility and is pure waste.  r3's TPU north-star spent ~2
-        joint QPs per pending pair; the common (feasible) case now costs 1.
+        Solve-order policy (outputs agree up to solver-tolerance edge
+        cases -- a row would have to pass the strict Farkas infeasibility
+        certificate AND exhibit a zero-slack elastic witness at once to
+        differ -- only the QP count meaningfully changes):
+
+        - min-first (single-commutation problems): run the elastic min
+          for every pair; a converged solve with slack 0 has exhibited a
+          hard-feasible point, so phase-1 runs only on the suspect rest.
+          Optimal when pairs are mostly feasible.
+        - phase1-first (hybrid problems, nd > 1): run phase-1/Farkas for
+          every pair; the elastic min runs only on rows NOT certified
+          infeasible.  Measured at the pendulum north star: ~99% of
+          pending (simplex, delta') pairs are infeasible-on-R exclusions,
+          so their elastic-min solves (the OLD first pass) were pure
+          waste -- this order halves stage-2 joint-QP volume in the tail
+          regime that dominates every hybrid build.
         """
         K = bary_Ms.shape[0]
         if K == 0:
             return np.zeros(0), np.zeros(0, dtype=bool)
-        self.n_solves += K
-        self.n_simplex_solves += K
         cap = self.max_simplex_rows_per_call
         outs, feas_sw = [], []
         for lo in range(0, K, cap):
-            Mj, dj = self._pad_simplex(bary_Ms[lo:lo + cap],
-                                       delta_idx[lo:lo + cap])
             Kc = min(cap, K - lo)
-            V, conv, _feas, t_el = self._simplex_min(Mj, dj)
-            V, conv = np.asarray(V)[:Kc], np.asarray(conv)[:Kc]
-            t_el = np.asarray(t_el)[:Kc]
-            out = np.where(conv, V, -_INF)
-            feasible_somewhere = conv & (t_el <= 1e-6)
-            need_p1 = ~feasible_somewhere
-            if np.any(need_p1):
-                idx = np.where(need_p1)[0]
-                self.n_solves += idx.size
-                self.n_simplex_solves += idx.size
-                t, t_conv, farkas = self._run_simplex_feas(
-                    bary_Ms[lo:lo + cap][idx], delta_idx[lo:lo + cap][idx])
+            Ms_c = bary_Ms[lo:lo + cap]
+            ds_c = delta_idx[lo:lo + cap]
+            if self.stage2_phase1_first:
+                self.n_solves += Kc
+                self.n_simplex_solves += Kc
+                t, t_conv, farkas = self._run_simplex_feas(Ms_c, ds_c)
                 infeasible = t_conv & (t > 1e-6) & farkas
-                out[idx[infeasible]] = _INF
-                feasible_somewhere[idx] = t_conv & (t <= 1e-6)
+                out = np.full(Kc, _INF)
+                feasible_somewhere = t_conv & (t <= 1e-6)
+                self._elastic_min_into(Ms_c, ds_c,
+                                       np.where(~infeasible)[0],
+                                       out, feasible_somewhere)
+            else:
+                out = np.full(Kc, -_INF)
+                feasible_somewhere = np.zeros(Kc, dtype=bool)
+                self._elastic_min_into(Ms_c, ds_c, np.arange(Kc),
+                                       out, feasible_somewhere)
+                need_p1 = ~feasible_somewhere
+                if np.any(need_p1):
+                    idx = np.where(need_p1)[0]
+                    self.n_solves += idx.size
+                    self.n_simplex_solves += idx.size
+                    t, t_conv, farkas = self._run_simplex_feas(
+                        Ms_c[idx], ds_c[idx])
+                    infeasible = t_conv & (t > 1e-6) & farkas
+                    out[idx[infeasible]] = _INF
+                    feasible_somewhere[idx] = t_conv & (t <= 1e-6)
             outs.append(out)
             feas_sw.append(feasible_somewhere)
         return np.concatenate(outs), np.concatenate(feas_sw)
+
+    def _elastic_min_into(self, Ms: np.ndarray, ds: np.ndarray,
+                          idx: np.ndarray, out: np.ndarray,
+                          feasible_somewhere: np.ndarray) -> None:
+        """Run the elastic simplex-min on rows `idx`, scattering the
+        (finite bound | -inf) encoding into `out` and OR-ing the
+        zero-slack feasibility witness into `feasible_somewhere`.  Shared
+        by both stage-2 solve orders so the encoding and the 1e-6 witness
+        tolerance live in exactly one place."""
+        if idx.size == 0:
+            return
+        self.n_solves += idx.size
+        self.n_simplex_solves += idx.size
+        Mj, dj = self._pad_simplex(Ms[idx], ds[idx])
+        V, conv, _feas, t_el = self._simplex_min(Mj, dj)
+        V = np.asarray(V)[:idx.size]
+        conv = np.asarray(conv)[:idx.size]
+        t_el = np.asarray(t_el)[:idx.size]
+        out[idx] = np.where(conv, V, -_INF)
+        feasible_somewhere[idx] |= conv & (t_el <= 1e-6)
 
     def _run_simplex_feas(self, Ms: np.ndarray, ds: np.ndarray
                           ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:

@@ -21,6 +21,16 @@ if "host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
 
+# Persistent compile cache dir (harmless no-op on the CPU backend in
+# this jax build -- it only writes for accelerator backends; the env var
+# mainly reaches the capture-script smoke tests' subprocesses so a
+# chip-up capture session shares warm compiles).
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR",
+    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                 ".jax_cache"))
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "2")
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")

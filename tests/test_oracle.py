@@ -210,3 +210,32 @@ def test_rescue_recovers_short_point_schedule():
     both = sb.conv & sr.conv
     assert np.allclose(sr.V[both], sb.V[both], atol=1e-6)
     np.testing.assert_array_equal(sr.dstar, sb.dstar)
+
+
+def test_stage2_orders_agree_on_hybrid():
+    """phase1-first (the hybrid auto default) and min-first must return
+    the same (Vmin, feasible_somewhere) encodings on a mixed batch of
+    feasible and infeasible (simplex, delta) pairs, with phase1-first
+    issuing fewer joint QPs when infeasible pairs dominate."""
+    prob = make("inverted_pendulum", N=2)
+    rng = np.random.default_rng(9)
+    Ms, ds = [], []
+    nd = prob.canonical.n_delta
+    for k in range(24):
+        lo = rng.uniform(prob.theta_lb, prob.theta_ub * 0.6)
+        V = np.vstack([lo, lo + [0.15, 0.0], lo + [0.0, 0.15]])
+        Ms.append(geometry.barycentric_matrix(V))
+        ds.append(k % nd)
+    Ms = np.stack(Ms)
+    ds = np.asarray(ds, dtype=np.int64)
+    o_p1 = Oracle(prob, backend="cpu")          # auto -> phase1_first
+    assert o_p1.stage2_phase1_first
+    o_min = Oracle(prob, backend="cpu", stage2_order="min_first")
+    V1, f1 = o_p1.solve_simplex_min(Ms, ds)
+    V2, f2 = o_min.solve_simplex_min(Ms, ds)
+    np.testing.assert_array_equal(V1, V2)
+    np.testing.assert_array_equal(f1, f2)
+    # The batch must actually exercise both outcomes for the equality to
+    # mean anything.
+    assert np.any(V1 == np.inf) and np.any(np.isfinite(V1))
+    assert o_p1.n_simplex_solves < o_min.n_simplex_solves

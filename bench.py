@@ -169,6 +169,43 @@ def schedule_kwargs(result: dict | None = None) -> dict:
     return kw
 
 
+def measure_serial_latencies(serial, problem,
+                             with_simplex: bool = True
+                             ) -> tuple[float, float]:
+    """(seconds per point QP, seconds per joint simplex QP) measured on a
+    serial-backend oracle.  Defines the serial-wall estimate behind
+    vs_baseline, so bench.py and north_star.py MUST share it -- two
+    copies once drifted and reported differently-defined speedups.
+    vmap amortization inside the padded simplex batch makes the simplex
+    figure a LOWER bound on true one-at-a-time cost (conservative
+    direction for the reported speedup)."""
+    from explicit_hybrid_mpc_tpu.partition import geometry
+
+    rng = np.random.default_rng(0)
+    pts = rng.uniform(problem.theta_lb, problem.theta_ub,
+                      size=(8, problem.n_theta))
+    serial.solve_vertices(pts[:2])  # compile
+    t0 = time.perf_counter()
+    serial.solve_vertices(pts)
+    per_point = ((time.perf_counter() - t0) / len(pts)
+                 / problem.canonical.n_delta)
+    per_simplex = 0.0
+    if with_simplex:
+        span = problem.theta_ub - problem.theta_lb
+        V0 = np.vstack([problem.theta_lb,
+                        problem.theta_lb + 0.1 * np.diag(span)])
+        M8 = np.tile(geometry.barycentric_matrix(V0)[None], (8, 1, 1))
+        d8 = np.zeros(8, dtype=np.int64)
+        serial.solve_simplex_min(M8, d8)  # compile
+        before = serial.n_simplex_solves
+        t0 = time.perf_counter()
+        for _ in range(4):
+            serial.solve_simplex_min(M8, d8)
+        issued = max(1, serial.n_simplex_solves - before)
+        per_simplex = (time.perf_counter() - t0) / issued
+    return per_point, per_simplex
+
+
 def warm_oracle(oracle, problem, stop_after: float | None = None) -> None:
     """Compile every vertex-batch AND simplex-batch bucket up front so
     compile time stays out of the timed region.  Mid-run bucket compiles
@@ -239,11 +276,8 @@ def warm_oracle(oracle, problem, stop_after: float | None = None) -> None:
         log(f"warmup: simplex bucket {b}")
         Ms = np.tile(M1[None], (b, 1, 1))
         ds = (np.arange(b, dtype=np.int64) % nd)
-        Mj, dj = oracle._pad_simplex(Ms, ds)
-        retry_transient(lambda: oracle._simplex_min(Mj, dj),
-                        what=f"simplex-min warmup {b}")
-        retry_transient(lambda: oracle._simplex_feas(Mj, dj),
-                        what=f"phase-1 warmup {b}")
+        retry_transient(lambda: oracle.warm_simplex_bucket(Ms, ds),
+                        what=f"simplex warmup {b}")
         b *= 2
 
 
@@ -334,39 +368,10 @@ def run(result: dict) -> None:
     # Point QPs and joint simplex QPs are structurally different sizes:
     # time each kind separately and weight by the counts the batched run
     # actually issued.
-    from explicit_hybrid_mpc_tpu.partition import geometry
-
     serial = Oracle(problem, backend="serial", precision=precision,
                     **sched_kw)
-    rng2 = np.random.default_rng(0)
-    pts = rng2.uniform(problem.theta_lb, problem.theta_ub,
-                       size=(8, problem.n_theta))
-    serial.solve_vertices(pts[:2])  # compile
-    t0 = time.perf_counter()
-    serial.solve_vertices(pts)
-    per_point = (time.perf_counter() - t0) / len(pts)
-    nd = problem.canonical.n_delta
-    per_solve = per_point / nd
-
-    per_simplex = 0.0
-    if n_simplex:
-        # solve_simplex_min pads K to >=8 rows, so time a FULL 8-row batch
-        # and divide by the 16 counted solves (8 min-QPs + 8 phase-1s) it
-        # actually runs; a K=1 call would execute the same 16 padded QPs
-        # and overstate the per-solve cost ~8x.  vmap amortization makes
-        # this a LOWER bound on true one-at-a-time serial cost, i.e. the
-        # reported speedup is conservative.
-        span = problem.theta_ub - problem.theta_lb
-        V0 = np.vstack([problem.theta_lb,
-                        problem.theta_lb + 0.1 * np.diag(span)])
-        M8 = np.tile(geometry.barycentric_matrix(V0)[None], (8, 1, 1))
-        d8 = np.zeros(8, dtype=np.int64)
-        serial.solve_simplex_min(M8, d8)  # compile
-        t0 = time.perf_counter()
-        for _ in range(4):
-            serial.solve_simplex_min(M8, d8)
-        per_simplex = (time.perf_counter() - t0) / (4 * 16)
-
+    per_solve, per_simplex = measure_serial_latencies(
+        serial, problem, with_simplex=bool(n_simplex))
     serial_wall = per_solve * n_point + per_simplex * n_simplex
     speedup = serial_wall / stats["wall_s"]
     log(f"serial: {per_solve*1e3:.2f} ms/point-solve x {n_point}, "

@@ -726,6 +726,18 @@ class Oracle:
         out[idx] = np.where(conv, V, -_INF)
         feasible_somewhere[idx] |= conv & (t_el <= 1e-6)
 
+    def warm_simplex_bucket(self, Ms: np.ndarray, ds: np.ndarray) -> None:
+        """Compile BOTH joint-QP programs (elastic min + phase-1) at the
+        padded bucket of `Ms` without counting solves.  Benchmark warmup
+        must hit every bucket directly: going through solve_simplex_min
+        only compiles the second program of the active stage-2 order on a
+        data-dependent subset, and the invariant "warm shapes == run
+        shapes" belongs inside Oracle, next to the padding scheme."""
+        Mj, dj = self._pad_simplex(np.asarray(Ms),
+                                   np.asarray(ds, dtype=np.int64))
+        self._simplex_min(Mj, dj)
+        self._simplex_feas(Mj, dj)
+
     def _run_simplex_feas(self, Ms: np.ndarray, ds: np.ndarray
                           ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
         """One padded+chunked pass of the joint phase-1 program (raw

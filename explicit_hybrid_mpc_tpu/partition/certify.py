@@ -175,6 +175,109 @@ def certify_suboptimal_stage1(sd: SimplexVertexData, eps_a: float,
                              _stage1_gap=stage1, _candidates=cands)
 
 
+def certify_stage1_batch(verts: np.ndarray, V: np.ndarray,
+                         conv: np.ndarray, grad: np.ndarray,
+                         Vstar: np.ndarray, dstar: np.ndarray,
+                         eps_a: float, eps_r: float
+                         ) -> list[CertificateResult]:
+    """Vectorized certify_suboptimal_stage1 over a batch of B simplices.
+
+    Shapes: verts (B, m, p), V/conv (B, m, nd), grad (B, m, nd, p),
+    Vstar/dstar (B, m).  Decision-identical to the scalar function node
+    by node (tests/test_partition.py asserts it on random batches and
+    end-to-end); it exists because the scalar path's per-node Python
+    loops (a tangent einsum per (node, candidate)) dominated host-side
+    certification time in steady-state profiles.
+
+    Memory note: the slack tensor is (B, C, m, m, nd) where C is the
+    batch's max candidate count -- candidates are the few vertex-optimal
+    commutations (C << nd), which keeps the tensor a few MB at the
+    shipping batch sizes rather than the (B, nd, m, m, nd) a dense
+    formulation would need.
+    """
+    B, m, nd = V.shape
+    results: list[CertificateResult | None] = [None] * B
+    feas_vertex = dstar >= 0                          # (B, m)
+    feas_any = feas_vertex.any(axis=1)
+    feas_all = feas_vertex.all(axis=1)
+    for b in np.where(~feas_any)[0]:
+        results[b] = CertificateResult(status="infeasible")
+    for b in np.where(feas_any & ~feas_all)[0]:
+        results[b] = CertificateResult(status="split")
+    todo = np.where(feas_all)[0]
+    if todo.size == 0:
+        return results
+
+    # Candidate sets: vertex-optimal commutations converged at EVERY
+    # vertex, in ascending order per node (matches candidate_set +
+    # the conv filter in the scalar path).
+    dmask = np.zeros((B, nd), dtype=bool)
+    np.put_along_axis(dmask, np.maximum(dstar, 0),
+                      feas_vertex, axis=1)            # d in dstar set
+    cand_mask = dmask & conv.all(axis=1)              # (B, nd)
+    n_c = cand_mask[todo].sum(axis=1)
+    for b in todo[n_c == 0]:
+        results[b] = CertificateResult(status="split")
+    todo = todo[n_c > 0]
+    if todo.size == 0:
+        return results
+    C = int(cand_mask[todo].sum(axis=1).max())
+    # Padded candidate index list (B', C), -1 = empty slot.
+    cand_idx = np.full((todo.size, C), -1, dtype=np.int64)
+    for r, b in enumerate(todo):                      # cheap: B' rows
+        ds = np.where(cand_mask[b])[0]
+        cand_idx[r, :ds.size] = ds
+    slot = cand_idx >= 0                              # (B', C)
+    safe_idx = np.maximum(cand_idx, 0)
+
+    vb = verts[todo]                                  # (B', m, p)
+    Vb, convb, gradb = V[todo], conv[todo], grad[todo]
+    # tangents[b, i, j, d] = V[b,i,d] + grad[b,i,d,:].(v_j - v_i)
+    dv = vb[:, None, :, :] - vb[:, :, None, :]        # (B', i, j, p)
+    with np.errstate(invalid="ignore"):
+        t = Vb[:, :, None, :] + np.einsum("bijk,bidk->bijd", dv, gradb)
+        # U[b, c, j] = V[b, j, cand c]
+        U = np.take_along_axis(
+            Vb, safe_idx[:, None, :], axis=2).transpose(0, 2, 1)
+        slack = U[:, :, None, :, None] - t[:, None, :, :, :]
+        worst = np.max(slack, axis=3)                 # (B', C, i, d)
+    worst = np.where(convb[:, None, :, :], worst, np.inf)
+    gaps = np.min(worst, axis=2)                      # (B', C, d)
+    none_conv = ~convb.any(axis=1)                    # (B', d)
+    gaps = np.where(none_conv[:, None, :], np.nan, gaps)
+
+    pending = none_conv.any(axis=1)                   # (B',)
+    # Nodes with pending deltas: hand stage-2 the per-candidate partial
+    # gaps exactly as the scalar path does.
+    for r in np.where(pending)[0]:
+        b = todo[r]
+        cands = cand_idx[r][slot[r]]
+        results[b] = CertificateResult(
+            status="pending", pending_deltas=np.where(none_conv[r])[0],
+            _stage1_gap=gaps[r][slot[r]], _candidates=cands)
+    # Complete nodes: best candidate by max-over-deltas gap (first
+    # minimum among slots = ascending candidate order, matching the
+    # scalar path's strict-< update).
+    done = np.where(~pending)[0]
+    if done.size:
+        g = np.max(gaps[done], axis=2)                # (D, C)
+        g = np.where(slot[done], g, np.inf)
+        ci = np.argmin(g, axis=1)
+        gbest = g[np.arange(done.size), ci]
+        for k, r in enumerate(done):
+            b = todo[r]
+            gk = float(gbest[k])
+            d = int(cand_idx[r, ci[k]])
+            if _passes(gk, Vstar[b], eps_a, eps_r):
+                results[b] = CertificateResult(
+                    status="certified", delta_idx=d,
+                    vertex_inputs=None, vertex_costs=V[b, :, d],
+                    vertex_z=None, gap=gk)
+            else:
+                results[b] = CertificateResult(status="split", gap=gk)
+    return results
+
+
 def certify_suboptimal_stage2(sd: SimplexVertexData, res: CertificateResult,
                               Vmin: dict[int, float], eps_a: float,
                               eps_r: float) -> CertificateResult:

@@ -481,14 +481,34 @@ class FrontierEngine:
         # the node splits.
         fresh: dict[int, dict[int, float]] = collections.defaultdict(dict)
         for n in nodes:
-            sd = self._vertex_data(n)
-            sds[n] = sd
-            if self.cfg.algorithm == "feasible":
-                res = certify.certify_feasible(sd)
-            else:
-                res = certify.certify_suboptimal_stage1(
-                    sd, self.cfg.eps_a, self.cfg.eps_r)
-            results[n] = res
+            sds[n] = self._vertex_data(n)
+        if self.cfg.algorithm == "feasible":
+            for n in nodes:
+                results[n] = certify.certify_feasible(sds[n])
+        else:
+            # Batched stage-1 certification: one vectorized pass over the
+            # whole batch (decision-identical to the scalar path; the
+            # per-node tangent einsums dominated host time).
+            res_list = certify.certify_stage1_batch(
+                np.stack([sds[n].verts for n in nodes]),
+                np.stack([sds[n].V for n in nodes]),
+                np.stack([sds[n].conv for n in nodes]),
+                np.stack([sds[n].grad for n in nodes]),
+                np.stack([sds[n].Vstar for n in nodes]),
+                np.stack([sds[n].dstar for n in nodes]),
+                self.cfg.eps_a, self.cfg.eps_r)
+            for n, res in zip(nodes, res_list):
+                if res.status == "certified":
+                    # The batch pass leaves the leaf payload to us (it
+                    # would otherwise haul the (B, m, nd, nz) z tensor
+                    # through every call).
+                    sd = sds[n]
+                    d = res.delta_idx
+                    res.vertex_inputs = sd.u0[:, d, :]
+                    res.vertex_z = sd.z[:, d, :]
+                results[n] = res
+        for n in nodes:
+            res = results[n]
             if res.status == "pending":
                 stage2.extend((n, int(d)) for d in res.pending_deltas)
             elif res.status == "infeasible":
@@ -610,7 +630,7 @@ class FrontierEngine:
                         self.tree.set_leaf(n, LeafData(
                             delta_idx=d, vertex_inputs=sd.u0[:, d, :],
                             vertex_costs=sd.V[:, d],
-                            vertex_z=sd.z[:, d, :]))
+                            vertex_z=sd.z[:, d, :], certified=False))
                     self._inherit.pop(n, None)
                     self._release(n)
                     continue

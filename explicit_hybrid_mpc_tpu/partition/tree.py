@@ -39,6 +39,11 @@ class LeafData:
     # Full primal sequences at the vertices (p+1, nz): their barycentric
     # interpolation is the certified feasible, eps-suboptimal input sequence.
     vertex_z: np.ndarray | None = None
+    # False for depth-cap best-effort leaves: the law is the best
+    # available candidate but carries NO eps-certificate.  Consumers must
+    # read it via getattr(ld, "certified", True) -- pre-field pickles
+    # restore without the attribute.
+    certified: bool = True
 
 
 class Tree:

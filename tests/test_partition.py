@@ -288,3 +288,52 @@ def test_prefetch_parity():
     assert sb["simplex_solves"] == sa["simplex_solves"]
     assert sa["point_solves"] <= sb["point_solves"] \
         <= int(1.05 * sa["point_solves"])
+
+
+def test_batched_stage1_matches_scalar():
+    """certify_stage1_batch must reproduce the scalar
+    certify_suboptimal_stage1 decision (status, delta, gap, pending set,
+    partial gaps) for every node of real frontier batches."""
+    from explicit_hybrid_mpc_tpu.partition import certify
+
+    prob = make("inverted_pendulum", N=3)
+    cfg = PartitionConfig(problem="inverted_pendulum", eps_a=0.5,
+                          backend="cpu", batch_simplices=64, max_depth=10,
+                          mask_point_solves=False, inherit_bounds=False)
+    oracle = Oracle(prob, backend="cpu")
+    eng = FrontierEngine(prob, oracle, cfg)
+    checked = 0
+    for _ in range(6):
+        if not eng.frontier:
+            break
+        nodes = list(eng.frontier)[:64]
+        plan = eng._plan_missing(nodes)
+        eng._consume_plan(plan, *eng._dispatch_plan(plan))
+        sds = {n: eng._vertex_data(n) for n in nodes}
+        batch = certify.certify_stage1_batch(
+            np.stack([sds[n].verts for n in nodes]),
+            np.stack([sds[n].V for n in nodes]),
+            np.stack([sds[n].conv for n in nodes]),
+            np.stack([sds[n].grad for n in nodes]),
+            np.stack([sds[n].Vstar for n in nodes]),
+            np.stack([sds[n].dstar for n in nodes]),
+            cfg.eps_a, cfg.eps_r)
+        for n, rb in zip(nodes, batch):
+            rs = certify.certify_suboptimal_stage1(sds[n], cfg.eps_a,
+                                                   cfg.eps_r)
+            assert rb.status == rs.status, (rb.status, rs.status)
+            checked += 1
+            if rs.status == "certified":
+                assert rb.delta_idx == rs.delta_idx
+                assert np.isclose(rb.gap, rs.gap)
+            elif rs.status == "pending":
+                np.testing.assert_array_equal(rb.pending_deltas,
+                                              rs.pending_deltas)
+                np.testing.assert_array_equal(rb._candidates,
+                                              rs._candidates)
+                np.testing.assert_allclose(rb._stage1_gap, rs._stage1_gap,
+                                           equal_nan=True)
+            elif rs.status == "split" and np.isfinite(rs.gap):
+                assert np.isclose(rb.gap, rs.gap)
+        eng.step()
+    assert checked > 150  # the comparison saw a real mix of batches

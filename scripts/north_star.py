@@ -86,9 +86,14 @@ def run(result: dict) -> None:
     log_path = os.environ.get("NS_LOG", "artifacts/north_star.log.jsonl")
     if os.path.exists(log_path):
         os.remove(log_path)
+    # max_depth 56: the pendulum's mode-boundary slivers certify by
+    # depth ~54; the old default cap of 40 left 44 best-effort leaves
+    # in an otherwise complete build (measured this session).
+    max_depth = int(os.environ.get("NS_MAX_DEPTH", "56"))
     cfg = PartitionConfig(problem=problem_name, eps_a=1e-2,
                           backend="device", batch_simplices=512,
                           max_steps=20_000, precision=precision,
+                          max_depth=max_depth,
                           time_budget_s=budget, log_path=log_path)
     res = build_partition(problem, cfg, oracle=oracle)
     n_point, n_simplex = oracle.n_point_solves, oracle.n_simplex_solves
@@ -139,6 +144,7 @@ def run(result: dict) -> None:
         pcfg = PartitionConfig(problem=problem_name,
                                eps_a=parity_eps, backend=backend,
                                batch_simplices=256, precision=precision,
+                               max_depth=max_depth,
                                time_budget_s=1800.0)
         orc = Oracle(problem, backend=backend, precision=precision,
                      points_cap=points_cap, **sched_kw)

@@ -339,9 +339,15 @@ def run(result: dict) -> None:
     remaining = deadline() - time.time() - 90.0  # reserve for baseline
     budget = max(60.0, min(time_budget, remaining))
     log(f"timed build (budget {budget:.0f}s, max_steps {max_steps})...")
+    # max_depth 56 (vs the engine default 40): the pendulum's
+    # mode-boundary slivers certify by depth ~54, so the headline build
+    # completes FULLY eps-certified instead of emitting best-effort
+    # leaves at the cap (same default as scripts/north_star.py).
     cfg = PartitionConfig(problem=problem_name, eps_a=eps_a,
                           backend="device", batch_simplices=batch,
                           max_steps=max_steps, precision=precision,
+                          max_depth=int(os.environ.get("BENCH_MAX_DEPTH",
+                                                       "56")),
                           time_budget_s=budget)
     res = build_partition(problem, cfg, oracle=oracle)
     stats = res.stats
@@ -359,6 +365,7 @@ def run(result: dict) -> None:
                   prefetched_steps=stats["prefetched_steps"],
                   wall_s=round(stats["wall_s"], 2),
                   truncated=stats["truncated"],
+                  uncertified=stats["uncertified"],
                   # Batches that fell back to the CPU twin mid-build (a
                   # flaky tunnel makes a 'tpu' number partially CPU-run;
                   # nonzero here flags that honestly).

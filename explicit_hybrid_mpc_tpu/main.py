@@ -223,10 +223,20 @@ def main(argv: list[str] | None = None) -> int:
             "explicit_us_per_step": cmp.explicit.mean_eval_us,
             "implicit_us_per_step": cmp.implicit.mean_eval_us,
             "online_speedup": cmp.speedup,
+            # Full trajectories so post.figures.plot_closed_loop can
+            # render the paper-style comparison from the artifact alone.
+            "trajectories": {
+                label: {"states": np.asarray(r.states).tolist(),
+                        "inputs": np.asarray(r.inputs).tolist()}
+                for label, r in (("explicit", cmp.explicit),
+                                 ("implicit", cmp.implicit))},
         }
         with open(f"{prefix}.sim.json", "w") as f:
             json.dump(sim_stats, f, indent=2)
-        print(json.dumps(sim_stats), file=sys.stderr)
+        # stderr keeps the compact summary; the trajectory arrays live
+        # only in the artifact (at T=1000 they are hundreds of KB).
+        print(json.dumps({k: v for k, v in sim_stats.items()
+                          if k != "trajectories"}), file=sys.stderr)
     return 0
 
 

@@ -50,10 +50,28 @@ def plot_partition_2d(tree: Tree, ax=None, color_by: str = "delta",
     return fig
 
 
+class _Traj:
+    """Duck-typed SimResult stand-in for trajectories loaded from a
+    PREFIX.sim.json artifact (plain lists)."""
+
+    def __init__(self, d: dict):
+        self.states = np.asarray(d["states"])
+        self.inputs = np.asarray(d["inputs"])
+
+
 def plot_closed_loop(sim_results: dict, state_idx=(0, 1), axes=None,
                      save: str | None = None):
-    """Overlay closed-loop trajectories {label: SimResult} in a 2-D state
-    projection plus input traces.  axes: optional pair of Axes."""
+    """Overlay closed-loop trajectories in a 2-D state projection plus
+    input traces.  Accepts {label: SimResult} from Simulator.run, or a
+    CLI PREFIX.sim.json dict (its "trajectories" section is used).
+    axes: optional pair of Axes."""
+    traj = sim_results.get("trajectories")
+    if isinstance(traj, dict) and all(isinstance(v, dict)
+                                      for v in traj.values()):
+        # CLI sim.json artifact (label -> {"states": ..., "inputs": ...});
+        # the type check keeps a {label: SimResult} dict whose label
+        # happens to be "trajectories" on the original path.
+        sim_results = {k: _Traj(v) for k, v in traj.items()}
     if axes is not None:
         axes = np.asarray(axes).ravel()
         fig = axes[0].figure

@@ -27,6 +27,15 @@ def test_build_and_outputs(tmp_path):
     assert os.path.exists(f"{prefix}.log.jsonl")
     sim = json.load(open(f"{prefix}.sim.json"))
     assert sim["cost_ratio"] < 1.1
+    # The artifact carries full trajectories and renders the paper-style
+    # closed-loop figure on its own.
+    assert len(sim["trajectories"]["explicit"]["states"]) == 11
+    from explicit_hybrid_mpc_tpu.post import figures  # forces Agg
+    fig_path = str(tmp_path / "cl_from_json.png")
+    figures.plot_closed_loop(sim, save=fig_path)
+    assert os.path.getsize(fig_path) > 0
+    import matplotlib.pyplot as plt
+    plt.close("all")
 
 
 def test_feasible_variant(tmp_path):

@@ -30,6 +30,9 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 ART = os.path.join(REPO, "artifacts")
 
+sys.path.insert(0, REPO)
+from bench import CACHE_DIR, CACHE_MIN_COMPILE_S  # noqa: E402
+
 # (artifact, script, env, timeout_s, platform_key)
 # Priority order = evidence value per chip-minute.  Budgets assume a
 # flaky tunnel: every script writes its artifact incrementally, so a
@@ -128,9 +131,9 @@ def run_capture(name: str, script: str, env_extra: dict, timeout: float) -> bool
     # Persistent compilation cache shared by every capture process: the
     # same warmup buckets recompile in each script through the tunnel
     # (minutes each); cached, they reload in seconds.
-    env.setdefault("JAX_COMPILATION_CACHE_DIR",
-                   os.path.join(REPO, ".jax_cache"))
-    env.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "2")
+    env.setdefault("JAX_COMPILATION_CACHE_DIR", CACHE_DIR)
+    env.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS",
+                   CACHE_MIN_COMPILE_S)
     env.update(env_extra)
     logpath = os.path.join(ART, name.replace(".json", ".log"))
     os.makedirs(ART, exist_ok=True)

@@ -782,7 +782,11 @@ class Oracle:
     # max_simplex_rows_per_call -- bounds the compiled-shape set to
     # {8..cap}, all warmable up front.  Each pair gathers its own
     # (H[d], G[d], ...) slice, so memory scales with the cap, not nd.
-    max_pairs_per_call: int = 4096
+    # 1024 (not 4096): chunking a big pair batch costs a few extra
+    # dispatches (~ms each), while the 2048/4096-row programs each cost
+    # a multi-minute remote compile through the axon tunnel -- long
+    # enough to trip the watcher's stall-kill and void a capture window.
+    max_pairs_per_call: int = 1024
 
     def solve_pairs(self, thetas: np.ndarray, delta_idx: np.ndarray
                     ) -> tuple[np.ndarray, np.ndarray, np.ndarray,

@@ -97,6 +97,32 @@ def needed() -> list:
             if artifact_platform(c[0], c[4]) not in ("tpu", "gpu")]
 
 
+def tuned_schedule_env(path: str | None = None) -> dict:
+    """BENCH_POINT_SCHEDULE / BENCH_RESCUE env derived from a captured
+    tune_schedule.json, so every capture AFTER the tuning sweep runs the
+    recommended (parity-verified) IPM schedule.  Empty when no on-chip
+    recommendation exists; explicit per-capture env still wins (callers
+    apply this first, env_extra second)."""
+    path = path or os.path.join(ART, "tune_schedule.json")
+    try:
+        with open(path) as f:
+            d = json.load(f)
+        if d.get("platform") not in ("tpu", "gpu"):
+            return {}
+        if not d.get("fastest_parity_ok"):
+            return {}
+        sched = d["parity_builds"]["fastest"]["schedule"]
+        env = {}
+        pt = sched.get("point")
+        if pt:
+            env["BENCH_POINT_SCHEDULE"] = f"{int(pt[0])},{int(pt[1])}"
+        if sched.get("rescue"):
+            env["BENCH_RESCUE"] = str(int(sched["rescue"]))
+        return env
+    except Exception:
+        return {}
+
+
 def _progress_mtime(name: str) -> float:
     """Latest mtime over every file the capture streams to (stdout log,
     artifact json, sibling .jsonl/.log files sharing the stem)."""
@@ -134,6 +160,7 @@ def run_capture(name: str, script: str, env_extra: dict, timeout: float) -> bool
     env.setdefault("JAX_COMPILATION_CACHE_DIR", CACHE_DIR)
     env.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS",
                    CACHE_MIN_COMPILE_S)
+    env.update(tuned_schedule_env())
     env.update(env_extra)
     logpath = os.path.join(ART, name.replace(".json", ".log"))
     os.makedirs(ART, exist_ok=True)

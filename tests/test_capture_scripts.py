@@ -127,3 +127,34 @@ def test_profile_capture_smoke(tmp_path):
         "PROFILE_TIME_BUDGET": "60",
     }, out, timeout=420)
     assert data["platform"] == "cpu"
+
+
+def test_tuned_schedule_env(tmp_path):
+    """The watcher derives BENCH_POINT_SCHEDULE / BENCH_RESCUE for later
+    captures from a chip-captured tune_schedule.json, and ignores CPU or
+    parity-failed recommendations."""
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    try:
+        from tpu_watch import tuned_schedule_env
+    finally:
+        sys.path.pop(0)
+
+    p = tmp_path / "tune_schedule.json"
+
+    def write(d):
+        p.write_text(json.dumps(d))
+        return tuned_schedule_env(str(p))
+
+    good = {"platform": "tpu", "fastest_parity_ok": True,
+            "parity_builds": {"fastest": {"schedule": {
+                "n_f32": 20, "n_f64": 10, "point": [12, 4],
+                "rescue": 30}}}}
+    assert write(good) == {"BENCH_POINT_SCHEDULE": "12,4",
+                           "BENCH_RESCUE": "30"}
+    assert write({**good, "platform": "cpu"}) == {}
+    assert write({**good, "fastest_parity_ok": False}) == {}
+    # fastest without a point override: nothing the env can express.
+    assert write({"platform": "tpu", "fastest_parity_ok": True,
+                  "parity_builds": {"fastest": {"schedule": {
+                      "n_f32": 16, "n_f64": 6}}}}) == {}
+    assert tuned_schedule_env(str(tmp_path / "missing.json")) == {}

@@ -70,6 +70,13 @@ class Tree:
     def add_root(self, V: np.ndarray) -> int:
         return self._add(V, parent=-1, depth=0)
 
+    def roots(self) -> list:
+        """Ids of the root simplices (parent == -1), in insertion order.
+        Lets a tree loaded from pickle feed the APIs that take the build
+        result's root list (online.descent.export_descent,
+        post.analysis.partition_report)."""
+        return [i for i, pa in enumerate(self.parent) if pa == -1]
+
     def _add(self, V: np.ndarray, parent: int, depth: int) -> int:
         assert V.shape == (self.p + 1, self.p)
         self.vertices.append(np.asarray(V, dtype=np.float64))

@@ -112,3 +112,18 @@ def test_controller_is_continuous_across_facets(built, rng):
         pair = jnp.asarray(np.stack([th, th + eps_step]))
         out = evaluator.evaluate(dev, pair)
         assert abs(float(out.u[0, 0]) - float(out.u[1, 0])) < 1e-4
+
+
+def test_tree_roots_survive_pickle(built, tmp_path):
+    """Tree.roots() recovers the build's root list from a loaded pickle,
+    so export_descent / partition_report work without the live
+    PartitionResult (docs/guide.md deployment path)."""
+    from explicit_hybrid_mpc_tpu.partition.tree import Tree
+
+    prob, res, table = built
+    path = str(tmp_path / "t.pkl")
+    res.tree.save(path)
+    loaded = Tree.load(path)
+    assert loaded.roots() == res.roots
+    dt = descent.export_descent(loaded, loaded.roots(), table)
+    assert dt.leaf_row.shape[0] == len(loaded)

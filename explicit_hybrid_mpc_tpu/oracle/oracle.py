@@ -22,6 +22,13 @@ device execution:
   diagnostic; the feasibility-variant leaf rule itself decides from the
   vertex cost-solve convergence flags (certify.certify_feasible).
 
+The reference's "variability ball" query (SURVEY.md section 3,
+`in_variability_ball` [M-med]: is the cost variation over the cell
+within tolerance?) has no separate method here: its role is played by
+the stage-1 tangent-gap certificate (partition/certify.tangent_gaps),
+which bounds max_R (U - V_delta) from the SAME vertex solves the oracle
+already returned -- zero extra solver queries, per docs/certificates.md.
+
 Backends (BASELINE.json north-star: "selectable as backend='tpu'"):
 - 'tpu' / 'cpu': the vmapped kernel jitted on that platform's devices.
 - 'serial': the same kernel, one problem at a time in a Python loop on CPU

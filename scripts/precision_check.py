@@ -139,6 +139,7 @@ def run(result: dict) -> None:
     # counts dict is written into result before the comparison.
     counts = {}
     result["builds"] = counts
+    mixed_res = None
     for precision in ("mixed", "f64"):
         orc = Oracle(problem, backend=dev_backend, precision=precision,
                      points_cap=2048 if on_acc else 256)
@@ -149,6 +150,8 @@ def run(result: dict) -> None:
                               time_budget_s=budget)
         t0 = time.time()
         res = build_partition(problem, cfg, oracle=orc)
+        if precision == "mixed":
+            mixed_res = res
         counts[precision] = {
             "regions": res.stats["regions"],
             "tree_nodes": res.stats["tree_nodes"],
@@ -169,6 +172,61 @@ def run(result: dict) -> None:
     result["mixed_speedup_vs_f64"] = (
         round(counts["f64"]["wall_s"] / counts["mixed"]["wall_s"], 2)
         if counts["mixed"]["wall_s"] else None)
+    _flush(result)
+
+    # -- 3. sampled eps-soundness of the MIXED tree ------------------------
+    # Region-count equality between the mixed and f64 builds can flip on
+    # eps-threshold ties (two solvers agreeing to 1e-8 can still certify
+    # at different depths near the boundary), so the meaningful guarantee
+    # is that the mixed build's OWN certificates hold: at sampled thetas,
+    # the interpolated input sequence is feasible and its cost is within
+    # eps of the enumerated optimum computed by the PURE-F64 oracle
+    # (same property as tests/test_partition.py::
+    # test_eps_suboptimality_property, here against f64 ground truth).
+    if mixed_res is not None and not counts["mixed"]["truncated"]:
+        from explicit_hybrid_mpc_tpu.partition import geometry
+
+        n_check = int(os.environ.get("PREC_SOUND_SAMPLES", "256"))
+        rng2 = np.random.default_rng(23)
+        ths = rng2.uniform(problem.theta_lb, problem.theta_ub,
+                           size=(n_check, problem.n_theta))
+        truth = Oracle(problem, backend=dev_backend, precision="f64")
+        tsol = retry_transient(lambda: truth.solve_vertices(ths),
+                               what="soundness ground truth")
+        can_np = problem.canonical
+        max_viol = -np.inf
+        max_excess = -np.inf
+        checked = skipped = 0
+        tree = mixed_res.tree
+        for k, th in enumerate(ths):
+            n = tree.locate(th, mixed_res.roots)
+            ld = tree.leaf_data[n] if n >= 0 else None
+            if ld is None or ld.delta_idx < 0 or not np.isfinite(
+                    tsol.Vstar[k]):
+                skipped += 1  # infeasible region / best-effort leaf
+                continue
+            lam = geometry.barycentric(tree.vertices[n], th)
+            zbar = lam @ ld.vertex_z
+            d = ld.delta_idx
+            viol = float(np.max(can_np.G[d] @ zbar - can_np.w[d]
+                                - can_np.S[d] @ th))
+            excess = float(can_np.value(d, th, zbar) - tsol.Vstar[k])
+            max_viol = max(max_viol, viol)
+            max_excess = max(max_excess, excess)
+            checked += 1
+        eps_budget = eps_a  # builds above run with eps_r = 0
+        result["mixed_sound_sampled"] = {
+            "n_checked": checked, "n_skipped": skipped,
+            "max_violation": (round(max_viol, 9)
+                              if checked else None),
+            "max_excess": (round(max_excess, 9) if checked else None),
+            "eps_budget": eps_budget,
+        }
+        result["mixed_eps_sound"] = bool(
+            checked and max_viol <= 1e-6
+            and max_excess <= eps_budget + 1e-6)
+        log(f"mixed soundness: {result['mixed_sound_sampled']} -> "
+            f"{result['mixed_eps_sound']}")
 
 
 def main() -> int:
@@ -184,7 +242,8 @@ def main() -> int:
         _flush(result)
         print(json.dumps(result))
     return 0 if ("error" not in result
-                 and result.get("mixed_vs_f64_regions_equal")) else 1
+                 and (result.get("mixed_vs_f64_regions_equal")
+                      or result.get("mixed_eps_sound"))) else 1
 
 
 if __name__ == "__main__":

@@ -158,3 +158,24 @@ def test_tuned_schedule_env(tmp_path):
                   "parity_builds": {"fastest": {"schedule": {
                       "n_f32": 16, "n_f64": 6}}}}) == {}
     assert tuned_schedule_env(str(tmp_path / "missing.json")) == {}
+
+
+def test_precision_check_smoke(tmp_path):
+    out = str(tmp_path / "precision.json")
+    data = _run("scripts/precision_check.py", {
+        "PREC_OUT": out,
+        "PREC_PROBLEM": "double_integrator",
+        "PREC_EPS": "0.5",
+        "PREC_POINTS": "32",
+        "PREC_TIME_BUDGET": "60",
+        "PREC_SOUND_SAMPLES": "64",
+    }, out, timeout=420)
+    assert data["platform"] == "cpu"
+    assert 0.0 <= data["f32_accept_rate"] <= 1.0
+    assert data["builds"]["mixed"]["regions"] > 0
+    assert data["builds"]["f64"]["regions"] > 0
+    # The guarantee that matters: the mixed tree's own certificates hold
+    # at sampled thetas against f64 ground truth.
+    snd = data["mixed_sound_sampled"]
+    assert snd["n_checked"] > 0
+    assert data["mixed_eps_sound"] is True, snd

@@ -1,0 +1,128 @@
+"""Certification-onset probes for the cluster-scale families (configs 4/5).
+
+BASELINE.md rows 4/5 state that the benchmark-size satellite (6-state,
+27 commutations) and quadrotor (4-D pv, 16 commutations) boxes are
+cluster-scale, citing "r3 onset probes" -- this script turns that prose
+into a committed artifact: for each family it builds the partition at a
+ladder of sub-box scales (box half-widths scaled by s), records
+regions / certified volume / truncation per scale, and for every
+COMPLETE (volume-1.0) build projects the full-box region count as
+R * (1/s)^p (uniform-density order-of-magnitude, labeled as such --
+region density actually grows toward constraint boundaries, so the
+projection is a LOWER bound in practice).
+
+Writes artifacts/onset_probes.json.  Env: ONSET_OUT, ONSET_BUDGET (s per
+build, default 300), ONSET_FAMILIES (comma list), ONSET_SCALES (comma
+floats, overrides the per-family ladder), plus bench.py's BENCH_PLATFORM
+/ BENCH_PROBE_TIMEOUT.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from bench import choose_backend, log, schedule_kwargs  # noqa: E402
+
+# family -> (problem name, eps_a, eps_r, scale ladder, kwargs builder)
+FAMILIES = {
+    "satellite": ("satellite", 1.0, 0.1, (0.1, 0.15, 0.25),
+                  lambda s: {"axes": 3, "omega_box": 0.12 * s,
+                             "h_box": 1.2 * s}),
+    "quadrotor": ("quadrotor", 1.0, 0.1, (0.02, 0.05, 0.1),
+                  lambda s: {"param": "pv", "pos_box": 4.0 * s,
+                             "vel_box": 2.0 * s}),
+    # smoke-test family: 2-state satellite z-slice, seconds per build
+    "satellite_z": ("satellite", 1.0, 0.1, (0.25, 1.0),
+                    lambda s: {"axes": 1, "omega_box": 0.12 * s,
+                               "h_box": 1.2 * s}),
+}
+
+OUT_PATH = os.environ.get("ONSET_OUT", "artifacts/onset_probes.json")
+
+
+def _flush(result: dict) -> None:
+    os.makedirs(os.path.dirname(OUT_PATH) or ".", exist_ok=True)
+    with open(OUT_PATH, "w") as f:
+        json.dump(result, f, indent=2)
+
+
+def run(result: dict) -> None:
+    budget = float(os.environ.get("ONSET_BUDGET", "300"))
+    fam_names = os.environ.get("ONSET_FAMILIES",
+                               "satellite,quadrotor").split(",")
+    scale_override = os.environ.get("ONSET_SCALES")
+    platform = choose_backend(result)
+    on_acc = platform != "cpu"
+
+    from explicit_hybrid_mpc_tpu.config import PartitionConfig
+    from explicit_hybrid_mpc_tpu.oracle.oracle import Oracle
+    from explicit_hybrid_mpc_tpu.partition.frontier import build_partition
+    from explicit_hybrid_mpc_tpu.post import analysis
+    from explicit_hybrid_mpc_tpu.problems.registry import make
+
+    sched_kw = schedule_kwargs(result)
+    result["per_build_budget_s"] = budget
+    fams = {}
+    result["families"] = fams
+    for fam in fam_names:
+        name, eps_a, eps_r, scales, kw_of = FAMILIES[fam]
+        if scale_override:
+            scales = tuple(float(x) for x in scale_override.split(","))
+        rows = []
+        fams[fam] = rows
+        for s in scales:
+            problem = make(name, **kw_of(s))
+            orc = Oracle(problem, backend="device" if on_acc else "cpu",
+                         precision="mixed",
+                         points_cap=2048 if on_acc else 256, **sched_kw)
+            cfg = PartitionConfig(problem=name, eps_a=eps_a, eps_r=eps_r,
+                                  backend="device", batch_simplices=256,
+                                  max_steps=100_000, precision="mixed",
+                                  time_budget_s=budget)
+            res = build_partition(problem, cfg, oracle=orc)
+            rep = analysis.partition_report(res.tree, res.roots)
+            p = problem.n_theta
+            complete = (not res.stats["truncated"]
+                        and res.stats["uncertified"] == 0)
+            row = {
+                "scale": s, "n_theta": p,
+                "regions": res.stats["regions"],
+                "truncated": res.stats["truncated"],
+                "uncertified": res.stats["uncertified"],
+                "wall_s": round(res.stats["wall_s"], 2),
+                "volume_certified_frac": round(
+                    rep["volume_certified_frac"], 6),
+                "complete": complete,
+                "projected_full_box_regions": (
+                    float(f"{res.stats['regions'] * (1.0 / s) ** p:.3g}")
+                    if complete and s < 1.0 else None),
+            }
+            rows.append(row)
+            log(f"  {fam} scale {s}: {row}")
+            _flush(result)
+
+
+def main() -> int:
+    result: dict = {"captured_at": time.strftime("%Y-%m-%d %H:%M:%S")}
+    try:
+        run(result)
+    except BaseException as e:
+        import traceback
+
+        result["error"] = repr(e)
+        traceback.print_exc(file=sys.stderr)
+    finally:
+        _flush(result)
+        print(json.dumps(result))
+    return 0 if "error" not in result else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

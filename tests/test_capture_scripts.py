@@ -179,3 +179,20 @@ def test_precision_check_smoke(tmp_path):
     snd = data["mixed_sound_sampled"]
     assert snd["n_checked"] > 0
     assert data["mixed_eps_sound"] is True, snd
+
+
+def test_onset_probe_smoke(tmp_path):
+    out = str(tmp_path / "onset.json")
+    data = _run("scripts/onset_probe.py", {
+        "ONSET_OUT": out,
+        "ONSET_FAMILIES": "satellite_z",
+        "ONSET_SCALES": "0.5",
+        "ONSET_BUDGET": "60",
+    }, out, timeout=420)
+    assert data["platform"] == "cpu"
+    rows = data["families"]["satellite_z"]
+    assert len(rows) == 1
+    assert rows[0]["regions"] > 0
+    assert rows[0]["complete"] in (True, False)
+    if rows[0]["complete"]:
+        assert rows[0]["projected_full_box_regions"] > rows[0]["regions"]

@@ -1,0 +1,122 @@
+"""Flagship tolerance ladder: complete pendulum builds at shrinking eps.
+
+The north star pins eps_a = 1e-2 (BASELINE.json); this capture shows the
+flagship (hybrid, 32-commutation) family keeps building COMPLETE,
+fully-certified partitions as the tolerance tightens -- the partition
+grows ~1/eps while regions/sec holds -- and exercises the O(depth)
+descent path on the hybrid tree at scale (the crossover artifact uses
+the double integrator; this one ties the flagship itself to the online
+path).  Writes artifacts/eps_ladder_<platform>.json.
+
+Env: LADDER_OUT, LADDER_EPS (comma floats, default "1e-2,5e-3,3e-3"),
+LADDER_BUDGET (s per build, default 420), LADDER_PROBLEM, plus bench.py's
+BENCH_PLATFORM / BENCH_PROBE_TIMEOUT.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from bench import choose_backend, log, schedule_kwargs  # noqa: E402
+
+
+def run(result: dict, out_path: str) -> None:
+    eps_list = [float(x) for x in os.environ.get(
+        "LADDER_EPS", "1e-2,5e-3,3e-3").split(",")]
+    budget = float(os.environ.get("LADDER_BUDGET", "420"))
+    problem_name = os.environ.get("LADDER_PROBLEM", "inverted_pendulum")
+    platform = choose_backend(result)
+    on_acc = platform != "cpu"
+
+    import jax.numpy as jnp
+
+    from explicit_hybrid_mpc_tpu.config import PartitionConfig
+    from explicit_hybrid_mpc_tpu.online import descent, evaluator, export
+    from explicit_hybrid_mpc_tpu.oracle.oracle import Oracle
+    from explicit_hybrid_mpc_tpu.partition.frontier import build_partition
+    from explicit_hybrid_mpc_tpu.problems.registry import make
+
+    problem = make(problem_name)
+    result["problem"] = problem_name
+    result["per_build_budget_s"] = budget
+    sched_kw = schedule_kwargs(result)
+    rows = []
+    result["rows"] = rows
+    oracle = Oracle(problem, backend="device" if on_acc else "cpu",
+                    precision="mixed",
+                    points_cap=2048 if on_acc else 256, **sched_kw)
+    rng = np.random.default_rng(5)
+    for eps in eps_list:
+        cfg = PartitionConfig(problem=problem_name, eps_a=eps,
+                              backend="device", batch_simplices=512,
+                              max_depth=60, precision="mixed",
+                              max_steps=50_000, time_budget_s=budget)
+        res = build_partition(problem, cfg, oracle=oracle)
+        s = res.stats
+        row = {"eps_a": eps, "regions": s["regions"],
+               "complete": (not s["truncated"]
+                            and s["uncertified"] == 0),
+               "uncertified": s["uncertified"],
+               "wall_s": round(s["wall_s"], 2),
+               "regions_per_s": round(s["regions_per_s"], 2),
+               "max_depth": s["max_depth"],
+               "oracle_solves": s["oracle_solves"]}
+        # O(depth) descent on the hybrid tree: export cost + us/query.
+        try:
+            table = export.export_leaves(res.tree)
+            t0 = time.perf_counter()
+            dt = descent.export_descent(res.tree, res.roots, table)
+            row["descent_export_s"] = round(time.perf_counter() - t0, 3)
+            dev = evaluator.stage(table)
+            qs = jnp.asarray(rng.uniform(problem.theta_lb, problem.theta_ub,
+                                         size=(4096, problem.n_theta)))
+            out = descent.evaluate_descent(dt, dev, qs)
+            out.u.block_until_ready()
+            t0 = time.perf_counter()
+            for _ in range(5):
+                out = descent.evaluate_descent(dt, dev, qs)
+            out.u.block_until_ready()
+            row["descent_us_per_query"] = round(
+                (time.perf_counter() - t0) / (5 * 4096) * 1e6, 3)
+        except Exception as e:  # online extras never void the build row
+            row["descent_error"] = repr(e)[:200]
+        rows.append(row)
+        log(f"  eps {eps}: {row}")
+        with open(out_path, "w") as f:
+            json.dump(result, f, indent=2)
+
+
+def main() -> int:
+    result: dict = {"captured_at": time.strftime("%Y-%m-%d %H:%M:%S")}
+    out_path = os.environ.get("LADDER_OUT", "artifacts/eps_ladder.json")
+    try:
+        os.makedirs(os.path.dirname(out_path) or ".", exist_ok=True)
+        run(result, out_path)
+        if not os.environ.get("LADDER_OUT") and result.get("platform"):
+            # Platform-tag the default path (known only after the probe).
+            tagged = out_path.replace(".json",
+                                      f"_{result['platform']}.json")
+            os.replace(out_path, tagged)
+            out_path = tagged
+    except BaseException as e:
+        import traceback
+
+        result["error"] = repr(e)
+        traceback.print_exc(file=sys.stderr)
+    finally:
+        with open(out_path, "w") as f:
+            json.dump(result, f, indent=2)
+        print(json.dumps(result))
+    return 0 if "error" not in result and all(
+        r.get("complete") for r in result.get("rows", [])) else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

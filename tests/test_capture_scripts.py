@@ -196,3 +196,20 @@ def test_onset_probe_smoke(tmp_path):
     assert rows[0]["complete"] in (True, False)
     if rows[0]["complete"]:
         assert rows[0]["projected_full_box_regions"] > rows[0]["regions"]
+
+
+def test_eps_ladder_smoke(tmp_path):
+    out = str(tmp_path / "ladder.json")
+    data = _run("scripts/eps_ladder.py", {
+        "LADDER_OUT": out,
+        "LADDER_PROBLEM": "double_integrator",
+        "LADDER_EPS": "0.5,0.2",
+        "LADDER_BUDGET": "60",
+    }, out, timeout=420)
+    assert data["platform"] == "cpu"
+    rows = data["rows"]
+    assert [r["eps_a"] for r in rows] == [0.5, 0.2]
+    assert rows[1]["regions"] > rows[0]["regions"]
+    for r in rows:
+        assert r["complete"] is True
+        assert r["descent_us_per_query"] > 0

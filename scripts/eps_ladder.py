@@ -54,6 +54,11 @@ def run(result: dict, out_path: str) -> None:
                     points_cap=2048 if on_acc else 256, **sched_kw)
     rng = np.random.default_rng(5)
     for eps in eps_list:
+        # The oracle is shared across rows for its warm jit caches; its
+        # counters are per-build facts, so reset them (a shared-counter
+        # bug once shipped cumulative oracle_solves in this artifact).
+        oracle.n_solves = oracle.n_point_solves = 0
+        oracle.n_simplex_solves = oracle.n_rescue_solves = 0
         cfg = PartitionConfig(problem=problem_name, eps_a=eps,
                               backend="device", batch_simplices=512,
                               max_depth=60, precision="mixed",

@@ -127,6 +127,30 @@ def tuned_schedule_env(path: str | None = None) -> dict:
         return {}
 
 
+def maybe_invalidate_bench() -> None:
+    """Re-queue the headline bench once an on-chip tuned schedule exists.
+
+    bench_tpu.json captures FIRST (cheapest artifact per chip-minute),
+    i.e. before tune_schedule.json can recommend anything -- so when the
+    sweep later lands a parity-verified recommendation, the committed
+    headline number predates it.  Move the untuned artifact aside; the
+    next watcher pass re-benches with tuned_schedule_env() injected
+    (whose overrides bench records as `schedule_overrides`, making this
+    a one-shot: a tuned artifact is never invalidated again)."""
+    if not tuned_schedule_env():
+        return
+    path = os.path.join(ART, "bench_tpu.json")
+    try:
+        with open(path) as f:
+            d = json.load(f)
+    except Exception:
+        return
+    if d.get("schedule_overrides") or d.get("platform") not in ("tpu", "gpu"):
+        return
+    os.replace(path, os.path.join(ART, "bench_tpu_untuned.json"))
+    log("tuned schedule available: re-queueing bench_tpu capture")
+
+
 def _progress_mtime(name: str) -> float:
     """Latest mtime over every file the capture streams to (stdout log,
     artifact json, sibling .jsonl/.log files sharing the stem)."""
@@ -235,7 +259,9 @@ def main() -> None:
         log(f"probe -> {plat}; {len(todo)} capture(s) pending")
         if plat not in (None, "cpu"):
             for name, script, env_extra, timeout, _keys in todo:
-                run_capture(name, script, env_extra, timeout)
+                ok = run_capture(name, script, env_extra, timeout)
+                if name == "tune_schedule.json" and ok:
+                    maybe_invalidate_bench()
                 commit()
                 if probe(probe_t) in (None, "cpu"):
                     log("chip lost mid-suite; back to polling")

@@ -213,3 +213,38 @@ def test_eps_ladder_smoke(tmp_path):
     for r in rows:
         assert r["complete"] is True
         assert r["descent_us_per_query"] > 0
+
+
+def test_maybe_invalidate_bench(tmp_path, monkeypatch):
+    """An untuned TPU bench artifact is re-queued exactly once after a
+    tuned recommendation lands; tuned or CPU artifacts are left alone."""
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    try:
+        import tpu_watch
+    finally:
+        sys.path.pop(0)
+    monkeypatch.setattr(tpu_watch, "ART", str(tmp_path))
+
+    def put(name, d):
+        with open(tmp_path / name, "w") as f:
+            json.dump(d, f)
+
+    tune = {"platform": "tpu", "fastest_parity_ok": True,
+            "parity_builds": {"fastest": {"schedule": {
+                "point": [12, 4], "rescue": 30}}}}
+    put("tune_schedule.json", tune)
+    put("bench_tpu.json", {"platform": "tpu", "value": 1.0})
+    tpu_watch.maybe_invalidate_bench()
+    assert not (tmp_path / "bench_tpu.json").exists()
+    assert (tmp_path / "bench_tpu_untuned.json").exists()
+
+    # Tuned artifact (schedule_overrides recorded): never invalidated.
+    put("bench_tpu.json", {"platform": "tpu", "value": 2.0,
+                           "schedule_overrides": {"point_schedule": [12, 4]}})
+    tpu_watch.maybe_invalidate_bench()
+    assert (tmp_path / "bench_tpu.json").exists()
+
+    # CPU-fallback artifact: left for the normal needed() re-queue.
+    put("bench_tpu.json", {"platform": "cpu", "value": 3.0})
+    tpu_watch.maybe_invalidate_bench()
+    assert (tmp_path / "bench_tpu.json").exists()

@@ -48,6 +48,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--mesh", type=int, default=None, metavar="D",
                    help="shard the solve batch over D local devices")
     p.add_argument("--max-depth", type=int, default=40)
+    p.add_argument("--boundary-depth", type=int, default=None,
+                   metavar="D", help="close mixed-feasibility simplices "
+                   "at depth >= D as semi-explicit boundary leaves "
+                   "(online fixed-delta QP) instead of splitting to "
+                   "--max-depth; closes the feasible-set boundary shell")
     p.add_argument("--max-steps", type=int, default=10_000)
     p.add_argument("--checkpoint-every", type=int, default=0, metavar="K",
                    help="snapshot frontier+tree every K steps")
@@ -130,6 +135,7 @@ def main(argv: list[str] | None = None) -> int:
         eps_r=args.eps_r if args.eps_r is not None else 0.0,
         algorithm=args.algorithm, backend=args.backend,
         batch_simplices=args.batch, max_depth=args.max_depth,
+        semi_explicit_boundary_depth=args.boundary_depth,
         max_steps=args.max_steps,
         checkpoint_every=args.checkpoint_every,
         checkpoint_path=(f"{prefix}.ckpt.pkl"
@@ -156,10 +162,15 @@ def main(argv: list[str] | None = None) -> int:
             # forward.  object.__setattr__ is the frozen-dataclass patch.
             object.__setattr__(snap_cfg, "problem_args",
                                cfg.problem_args)
+        # (Pre-boundary-closure snapshots need no back-fill: the new
+        # semi_explicit_boundary_depth field has a plain class-level
+        # default, so attribute lookup on old pickles already yields
+        # None -- the feature stays off for resumed old builds.)
         for fld in ("problem", "problem_args", "eps_a", "eps_r",
                     "algorithm", "backend", "precision",
                     "ipm_point_schedule", "ipm_rescue_iters",
-                    "batch_simplices", "max_depth"):
+                    "batch_simplices", "max_depth",
+                    "semi_explicit_boundary_depth"):
             cli_v = getattr(cfg, fld)
             # default: pre-problem_args snapshots lack the field
             snap_v = getattr(snap_cfg, fld, cli_v)

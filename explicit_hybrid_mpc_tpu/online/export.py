@@ -54,3 +54,15 @@ def export_leaves(tree: Tree) -> LeafTable:
         bary_M=np.stack(Ms), U=np.stack(Us), V=np.stack(Vs),
         delta=np.asarray(ds, dtype=np.int32),
         node_id=np.asarray(ids, dtype=np.int32))
+
+
+def semi_explicit_mask(tree: Tree, table: LeafTable) -> np.ndarray:
+    """(L,) bool: which table rows are semi-explicit boundary leaves.
+
+    Those rows' interpolated laws are fallbacks only; the deployed
+    controller must route them through the online fixed-delta QP
+    (sim.SemiExplicitController(semi_mask=...)).  Kept out of LeafTable
+    itself so pure eps-certified partitions pay nothing.
+    """
+    return np.array([getattr(tree.leaf_data[int(n)], "semi_explicit", False)
+                     for n in table.node_id], dtype=bool)

@@ -1,0 +1,132 @@
+"""Best-first branch-and-bound serial baseline (the honest Gurobi stand-in).
+
+The reference's per-point oracle is a Gurobi branch-and-bound MICP solve
+(SURVEY.md section 4.1 hot loop, [NS] "serial Gurobi oracle"; reference
+mount empty -- no file:line exists).  bench.py's original vs_baseline
+priced the serial alternative as flat enumeration of all n_delta
+fixed-commutation QPs per point at vmap-amortized per-QP latency --
+conservative in per-QP latency but generous in solve COUNT, since a real
+B&B prunes.  This module implements the enumeration-with-pruning
+algorithm the round-3 verdict asked for: best-first over the finite
+commutation family with incumbent pruning, one QP per compiled program,
+so bench.py can report a measured B&B-style baseline alongside the flat
+estimate.
+
+Algorithm per point theta:
+
+1. Root bounds: LB(d) = unconstrained minimum of the fixed-d QP,
+   -1/2 q_d' H_d^{-1} q_d plus the theta-only cost terms.  Valid lower
+   bound: dropping the inequality rows only enlarges the feasible set.
+   Cholesky factors of each H[d] are computed once at construction.
+2. Best-first: visit commutations in ascending-LB order, solving the
+   full QP one at a time (Oracle._solve_pair_one -- one QP per program,
+   the 'serial' backend contract); keep the incumbent V_best.
+3. Incumbent pruning: stop at the first candidate whose LB >= V_best;
+   the visit order is sorted, so every later candidate is pruned with it.
+
+The commutation family is flat (complete commutations are enumerated by
+the canonicalization, problems/base.py), so best-first + incumbent
+pruning over it is the exact finite-family specialization of B&B: there
+are no partial-assignment relaxations left to branch on.
+
+Both baselines are deliberately reported side by side: the flat estimate
+understates serial cost (no per-call overhead, vmap amortization), while
+the B&B stand-in's unconstrained root bound is weaker than a commercial
+solver's presolve+relaxation bounds, which overstates the QP count a
+little.  The truth lies between; each JSON field says which is which.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+from scipy.linalg import cho_factor, cho_solve
+
+
+class SerialBnB:
+    """Best-first enumeration with incumbent pruning, one QP at a time.
+
+    Wraps a backend='serial' Oracle; uses its single-pair jitted program
+    (one QP per dispatch) and its iteration schedule, so the B&B baseline
+    and the batched engine share the same solver kernel and tolerance.
+    """
+
+    def __init__(self, oracle):
+        if oracle.backend != "serial":
+            raise ValueError("SerialBnB requires a backend='serial' Oracle "
+                             f"(got {oracle.backend!r}): the baseline's "
+                             "contract is one QP per program dispatch")
+        self.oracle = oracle
+        can = oracle.can
+        self.can = can
+        # Cholesky of each commutation's (PD, problems/base.py canonical()
+        # asserts it) Hessian for the unconstrained root bound.
+        self._chol = [cho_factor(can.H[d]) for d in range(can.n_delta)]
+        self.n_qp_solves = 0      # full QPs dispatched across solve_point calls
+        self.n_pruned = 0         # commutations eliminated by the bound
+
+    def root_bounds(self, theta: np.ndarray) -> np.ndarray:
+        """(n_delta,) valid lower bounds on V_d(theta): the unconstrained
+        minimum -1/2 q' H^-1 q plus the theta-only cost terms that
+        _solve_one adds to the QP objective."""
+        can = self.can
+        th = np.asarray(theta, dtype=np.float64)
+        lbs = np.empty(can.n_delta)
+        for d in range(can.n_delta):
+            q = can.f[d] + can.F[d] @ th
+            lbs[d] = (-0.5 * q @ cho_solve(self._chol[d], q)
+                      + 0.5 * th @ can.Y[d] @ th + can.pvec[d] @ th
+                      + can.cconst[d])
+        return lbs
+
+    def solve_point(self, theta: np.ndarray):
+        """MICP at one point by best-first enumeration with pruning.
+
+        Returns (Vstar, dstar, n_qp) where n_qp is the number of full QPs
+        actually dispatched (n_delta - n_qp were pruned or cut off).
+        Vstar=+inf / dstar=-1 when no commutation admits a converged
+        feasible solve -- same convention as VertexSolution.
+        """
+        import jax.numpy as jnp
+
+        lbs = self.root_bounds(theta)
+        order = np.argsort(lbs, kind="stable")  # deterministic ties
+        th_dev = jnp.asarray(theta, dtype=jnp.float64)
+        v_best, d_best, n_qp = np.inf, -1, 0
+        for d in order:
+            if lbs[d] >= v_best:
+                # Sorted visit order: everything from here on is pruned.
+                self.n_pruned += self.can.n_delta - n_qp
+                break
+            V, conv, _feas, _g, _u0, _z = self.oracle._solve_pair_one(
+                th_dev, jnp.int32(d))
+            n_qp += 1
+            if bool(conv) and float(V) < v_best:
+                v_best, d_best = float(V), int(d)
+        self.n_qp_solves += n_qp
+        return v_best, d_best, n_qp
+
+    def measure(self, thetas: np.ndarray) -> dict:
+        """Timed B&B solves over a point sample; the per-point cost model
+        bench.py extrapolates the serial wall from.
+
+        The first point is solved once untimed so the single-pair program
+        compile stays out of the measurement (matching how the batched
+        build's warmup excludes compiles)."""
+        thetas = np.atleast_2d(thetas)
+        self.solve_point(thetas[0])  # compile
+        n0_qp, n0_pruned = self.n_qp_solves, self.n_pruned
+        t0 = time.perf_counter()
+        for th in thetas:
+            self.solve_point(th)
+        wall = time.perf_counter() - t0
+        n_pts = len(thetas)
+        n_qp = self.n_qp_solves - n0_qp
+        return {
+            "points": n_pts,
+            "s_per_point": wall / n_pts,
+            "qp_per_point": n_qp / n_pts,
+            "pruned_per_point": (self.n_pruned - n0_pruned) / n_pts,
+            "n_delta": self.can.n_delta,
+        }

@@ -68,6 +68,11 @@ class CertificateResult:
     gap: float = np.inf
     # Commutations needing a stage-2 simplex-min solve (converged nowhere).
     pending_deltas: np.ndarray | None = None
+    # True on a 'split' caused by MIXED vertex feasibility (the hybrid
+    # feasible set's boundary crosses R): no whole-simplex certificate can
+    # ever close such a cell, so the frontier may instead close it as a
+    # semi-explicit boundary leaf (cfg.semi_explicit_boundary_depth).
+    mixed_feasibility: bool = False
     # Internal: stage-1 partial gaps, completed by stage 2.
     _stage1_gap: np.ndarray | None = None
     _candidates: np.ndarray | None = None
@@ -92,6 +97,48 @@ def best_feasible_candidate(sd: SimplexVertexData) -> int | None:
         return None
     tot = np.array([np.sum(sd.V[:, int(d)]) for d in cands])
     return int(cands[int(np.argmin(tot))])
+
+
+def boundary_candidate(sd: SimplexVertexData) -> int | None:
+    """Commutation stored by a semi-explicit BOUNDARY leaf (mixed vertex
+    feasibility; round-3 verdict item 4).
+
+    Chooses the commutation converged at the MOST vertices (maximizing
+    the convex-hull sub-region where offline vertex feasibility +
+    convexity already guarantee the online fixed-delta QP succeeds);
+    ties break to the lowest mean cost over converged vertices, then the
+    lowest index.  Deterministic, so backend/tree parity is preserved.
+    None when no commutation converged at any vertex.
+    """
+    n_conv = sd.conv.sum(axis=0)
+    if n_conv.max(initial=0) == 0:
+        return None
+    cand = np.where(n_conv == n_conv.max())[0]
+    means = np.array([float(np.mean(sd.V[sd.conv[:, d], d]))
+                      for d in cand])
+    return int(cand[int(np.argmin(means))])
+
+
+def boundary_payload(sd: SimplexVertexData, d: int
+                     ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Finite leaf payload (u0, V, z) for a semi-explicit boundary leaf.
+
+    Vertices where commutation d did not converge hold +inf costs and
+    garbage inputs; they are filled deterministically (inputs/z with the
+    mean over converged vertices, costs with the converged max) so the
+    exported table stays finite.  The fills only feed the online FALLBACK
+    interpolation -- the boundary leaf's primary online path is the
+    fixed-delta QP (sim.SemiExplicitController), which never reads them
+    when it converges.
+    """
+    conv = sd.conv[:, d]
+    u = sd.u0[:, d, :].copy()
+    z = sd.z[:, d, :].copy()
+    V = sd.V[:, d].copy()
+    u[~conv] = u[conv].mean(axis=0)
+    z[~conv] = z[conv].mean(axis=0)
+    V[~conv] = V[conv].max()
+    return u, V, z
 
 
 def tangent_gaps(sd: SimplexVertexData, U: np.ndarray) -> np.ndarray:
@@ -139,7 +186,7 @@ def certify_suboptimal_stage1(sd: SimplexVertexData, eps_a: float,
         return CertificateResult(status="infeasible")
     if not np.all(feas_vertex):
         # Mixed feasibility: the feasible/infeasible boundary crosses R.
-        return CertificateResult(status="split")
+        return CertificateResult(status="split", mixed_feasibility=True)
 
     cands = candidate_set(sd)
     # Candidates must be feasible (converged) at every vertex to define U.
@@ -203,7 +250,8 @@ def certify_stage1_batch(verts: np.ndarray, V: np.ndarray,
     for b in np.where(~feas_any)[0]:
         results[b] = CertificateResult(status="infeasible")
     for b in np.where(feas_any & ~feas_all)[0]:
-        results[b] = CertificateResult(status="split")
+        results[b] = CertificateResult(status="split",
+                                       mixed_feasibility=True)
     todo = np.where(feas_all)[0]
     if todo.size == 0:
         return results
@@ -319,7 +367,7 @@ def certify_feasible(sd: SimplexVertexData) -> CertificateResult:
     if not np.any(feas_vertex):
         return CertificateResult(status="infeasible")
     if not np.all(feas_vertex):
-        return CertificateResult(status="split")
+        return CertificateResult(status="split", mixed_feasibility=True)
     d = best_feasible_candidate(sd)
     if d is None:
         return CertificateResult(status="split")

@@ -108,6 +108,7 @@ class FrontierEngine:
         self.cache = VertexCache()
         self.steps = 0
         self.n_uncertified = 0
+        self.n_semi_explicit = 0
         self.n_unique_solves = 0
         self.n_device_failures = 0
         self.n_point_skips = 0
@@ -620,6 +621,32 @@ class FrontierEngine:
             elif res.status == "infeasible":
                 pass  # leaf with no data: outside the feasible region
             else:  # split
+                # Boundary closure (round-3 verdict item 4): a
+                # mixed-feasibility split can NEVER certify -- the hybrid
+                # feasible set's boundary crosses R, and every descendant
+                # straddling it inherits the problem.  At depth >=
+                # semi_explicit_boundary_depth, close it as a
+                # semi-explicit leaf instead: the stored commutation is
+                # certified feasible on the converged-vertex hull
+                # (convexity), and the online path solves the fixed-delta
+                # QP at the query point (SemiExplicitController), which
+                # establishes feasibility per query.
+                sb = self.cfg.semi_explicit_boundary_depth
+                if (sb is not None and res.mixed_feasibility
+                        and self.tree.depth[n] >= sb):
+                    sd = sds[n]
+                    d = certify.boundary_candidate(sd)
+                    if d is not None:
+                        u, V, z = certify.boundary_payload(sd, d)
+                        self.tree.set_leaf(n, LeafData(
+                            delta_idx=d, vertex_inputs=u, vertex_costs=V,
+                            vertex_z=z, certified=False,
+                            semi_explicit=True))
+                        self.n_semi_explicit += 1
+                        n_leaves += 1
+                        self._inherit.pop(n, None)
+                        self._release(n)
+                        continue
                 if self.tree.depth[n] >= self.cfg.max_depth:
                     # Depth cap: accept the best available candidate as an
                     # UNcertified best-effort leaf, flag it in stats.
@@ -724,6 +751,11 @@ class FrontierEngine:
             "rescue_solves": self.oracle.n_rescue_solves,
             "inherited_skips": self.n_inherited_skips,
             "uncertified": self.n_uncertified,
+            # Semi-explicit boundary leaves (mixed vertex feasibility
+            # closed via cfg.semi_explicit_boundary_depth): their volume
+            # counts as covered-but-not-eps-certified; post.analysis
+            # reports the certified/semi-explicit split.
+            "semi_explicit": self.n_semi_explicit,
             # Non-empty frontier here means the run hit max_steps: the
             # remaining simplices are UNCOVERED holes, not a complete
             # partition -- callers must check this.
@@ -766,6 +798,7 @@ class FrontierEngine:
                 "frontier": list(self.frontier),
                 "cache": self.cache._d, "steps": self.steps,
                 "n_uncertified": self.n_uncertified,
+                "n_semi_explicit": self.n_semi_explicit,
                 "n_unique_solves": self.n_unique_solves,
                 "n_solves": self.oracle.n_solves,
                 "n_point_solves": self.oracle.n_point_solves,
@@ -808,6 +841,7 @@ class FrontierEngine:
         eng.cache._d = snap["cache"]
         eng.steps = snap["steps"]
         eng.n_uncertified = snap["n_uncertified"]
+        eng.n_semi_explicit = snap.get("n_semi_explicit", 0)
         eng.n_unique_solves = snap.get("n_unique_solves", 0)
         eng.n_device_failures = 0
         eng._inherit = dict(snap.get("inherit", {}))

@@ -44,6 +44,13 @@ class LeafData:
     # read it via getattr(ld, "certified", True) -- pre-field pickles
     # restore without the attribute.
     certified: bool = True
+    # True for semi-explicit BOUNDARY leaves: the commutation converged at
+    # only part of the cell's vertices (the hybrid feasible set's boundary
+    # crosses it), so the online path must solve the fixed-delta QP at the
+    # query point (sim.SemiExplicitController) instead of trusting the
+    # interpolated law; feasibility is then established per query by the
+    # QP itself.  Read via getattr(ld, "semi_explicit", False).
+    semi_explicit: bool = False
 
 
 class Tree:

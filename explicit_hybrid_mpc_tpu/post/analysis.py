@@ -28,16 +28,23 @@ def partition_report(tree: Tree, roots: list[int] | None = None) -> dict:
     """
     leaves = tree.leaves()
     cert = [i for i in leaves if tree.leaf_data[i] is not None]
+    # Semi-explicit boundary leaves (mixed vertex feasibility closed via
+    # cfg.semi_explicit_boundary_depth): covered, online-guaranteed via
+    # the fixed-delta QP, but NOT eps-certified -- reported separately
+    # from both certified volume and depth-cap best-effort volume.
+    semi = {i for i in cert
+            if getattr(tree.leaf_data[i], "semi_explicit", False)}
     # Depth-cap best-effort leaves carry a law but NO eps-certificate;
     # they must not inflate the certified-volume figure (getattr: trees
     # pickled before the `certified` field restore without it).
-    best_effort = [i for i in cert
-                   if not getattr(tree.leaf_data[i], "certified", True)]
+    best_effort = [i for i in cert if i not in semi
+                   and not getattr(tree.leaf_data[i], "certified", True)]
     vol = {i: geometry.simplex_volume(tree.vertices[i]) for i in leaves}
     roots = roots if roots is not None else [
         i for i in range(len(tree)) if tree.parent[i] < 0]
     total = sum(geometry.simplex_volume(tree.vertices[r]) for r in roots)
-    v_cert = sum(vol[i] for i in cert) - sum(vol[i] for i in best_effort)
+    v_cert = (sum(vol[i] for i in cert) - sum(vol[i] for i in best_effort)
+              - sum(vol[i] for i in semi))
     depths = np.asarray([tree.depth[i] for i in cert], dtype=np.int64)
     per_delta = collections.Counter(
         int(tree.leaf_data[i].delta_idx) for i in cert)
@@ -52,6 +59,9 @@ def partition_report(tree: Tree, roots: list[int] | None = None) -> dict:
         "n_best_effort": len(best_effort),
         "volume_best_effort_frac": (sum(vol[i] for i in best_effort)
                                     / total if total else 0.0),
+        "n_semi_explicit": len(semi),
+        "volume_semi_explicit_frac": (sum(vol[i] for i in semi)
+                                      / total if total else 0.0),
         "depth_min": int(depths.min()) if depths.size else 0,
         "depth_max": int(depths.max()) if depths.size else 0,
         "depth_mean": float(depths.mean()) if depths.size else 0.0,

@@ -121,11 +121,19 @@ class SemiExplicitController:
     """
 
     def __init__(self, table: LeafTable, oracle: Oracle,
-                 backend: str = "jax", interpret: bool | None = None):
+                 backend: str = "jax", interpret: bool | None = None,
+                 semi_mask=None):
+        """semi_mask: optional (L,) bool (online.export.semi_explicit_mask).
+        When given, only rows marked True take the online fixed-delta QP
+        path; the rest return the interpolated eps-certified law directly.
+        This deploys a HYBRID partition -- eps-certified interior +
+        semi-explicit boundary leaves (cfg.semi_explicit_boundary_depth).
+        None = every leaf is semi-explicit (a pure 'feasible' build)."""
         self.oracle = oracle
         self._loc = ExplicitController(table, backend=backend,
                                        interpret=interpret)
         self.table = table
+        self.semi_mask = semi_mask
         # Warm the fixed-delta jit bucket (timing parity with the other
         # controllers' warmup).
         n = oracle.n_solves
@@ -138,6 +146,13 @@ class SemiExplicitController:
         t0 = time.perf_counter()
         out = self._loc._eval(self._loc._jnp.asarray(theta[None]))
         leaf = int(out.leaf[0])
+        if self.semi_mask is not None and not self.semi_mask[leaf]:
+            # eps-certified leaf of a hybrid partition: the interpolated
+            # law already carries the certificate; no online QP.
+            return (np.asarray(out.u[0]),
+                    StepInfo(eval_s=time.perf_counter() - t0,
+                             inside=bool(out.inside[0]),
+                             cost_pred=float(out.cost[0])))
         d = int(self.table.delta[leaf])
         u0, V, conv, _z = self.oracle.solve_fixed(theta[None],
                                                   np.array([d]))

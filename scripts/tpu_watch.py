@@ -190,9 +190,17 @@ def run_capture(name: str, script: str, env_extra: dict, timeout: float) -> bool
                    CACHE_MIN_COMPILE_S)
     env.update(tuned_schedule_env())
     env.update(env_extra)
-    logpath = os.path.join(ART, name.replace(".json", ".log"))
+    # The attempt streams to a side file; only a SUCCESSFUL run replaces
+    # <stem>.log.  A stalled/killed attempt lands in <stem>.failed.log so
+    # the last good capture evidence is never clobbered (r3 advisor
+    # finding: a stall-killed warmup overwrote the only complete TPU
+    # bench log in HEAD).
+    stem = name.replace(".json", "")
+    logpath = os.path.join(ART, stem + ".log")
+    attempt = os.path.join(ART, stem + ".attempt.log")
+    outcome = "completed"
     os.makedirs(ART, exist_ok=True)
-    with open(logpath, "w") as lf:
+    with open(attempt, "w") as lf:
         child = subprocess.Popen([sys.executable, script], cwd=REPO,
                                  env=env, stdout=lf,
                                  stderr=subprocess.STDOUT)
@@ -202,6 +210,7 @@ def run_capture(name: str, script: str, env_extra: dict, timeout: float) -> bool
             now = time.time()
             if now - t0 > timeout:
                 log(f"  {name}: TIMED OUT after {timeout}s")
+                outcome = "timeout"
                 child.kill()
                 child.wait()
                 break
@@ -209,6 +218,7 @@ def run_capture(name: str, script: str, env_extra: dict, timeout: float) -> bool
             if now - last > stall_s:
                 log(f"  {name}: STALLED ({stall_s}s with no file "
                     "progress); killing")
+                outcome = "stall-killed"
                 child.terminate()
                 try:
                     child.wait(timeout=20)
@@ -216,10 +226,26 @@ def run_capture(name: str, script: str, env_extra: dict, timeout: float) -> bool
                     child.kill()
                     child.wait()
                 break
+        if outcome == "completed" and child.returncode != 0:
+            outcome = f"exit {child.returncode}"
     plat = artifact_platform(name, dict(zip([c[0] for c in CAPTURES],
                                             [c[4] for c in CAPTURES]))[name])
-    log(f"  {name}: platform={plat}")
-    return plat in ("tpu", "gpu")
+    # Success criterion MUST match needed()'s (artifact platform), or a
+    # run that wrote a valid TPU artifact before stalling in teardown
+    # would be logged "will retry" yet silently dropped from the queue
+    # with its evidence log shunted aside.
+    ok = plat in ("tpu", "gpu")
+    if ok:
+        os.replace(attempt, logpath)
+    else:
+        os.replace(attempt, os.path.join(ART, stem + ".failed.log"))
+    # Distinguish "the run wedged/was killed" from "the chip answered cpu/
+    # nothing", and say explicitly whether the capture stays queued: an
+    # unsuccessful attempt leaves the artifact non-TPU, so needed() keeps
+    # it pending and the next probe-positive pass retries it.
+    log(f"  {name}: outcome={outcome} artifact_platform={plat} "
+        f"{'CAPTURED' if ok else 'will retry on next chip window'}")
+    return ok
 
 
 def commit() -> None:

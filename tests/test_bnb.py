@@ -1,0 +1,78 @@
+"""B&B-style serial baseline (oracle/bnb.py): bound validity, parity with
+flat enumeration, and that pruning actually prunes.
+
+The baseline exists so bench.py's vs_baseline_bnb prices the reference's
+serial branch-and-bound oracle honestly (SURVEY.md section 4.1 hot loop;
+round-3 verdict item 8).  Its correctness contract: same Vstar as the
+enumeration oracle (what the partition engine consumes), never more QPs
+than flat enumeration.
+"""
+
+import numpy as np
+import pytest
+
+from explicit_hybrid_mpc_tpu.oracle.bnb import SerialBnB
+from explicit_hybrid_mpc_tpu.oracle.oracle import Oracle
+from explicit_hybrid_mpc_tpu.problems.registry import make
+
+
+@pytest.fixture(scope="module")
+def pendulum():
+    return make("inverted_pendulum", N=3)
+
+
+@pytest.fixture(scope="module")
+def serial(pendulum):
+    return Oracle(pendulum, backend="serial")
+
+
+@pytest.fixture(scope="module")
+def points(pendulum):
+    rng = np.random.default_rng(77)
+    return rng.uniform(pendulum.theta_lb, pendulum.theta_ub,
+                       size=(12, pendulum.n_theta))
+
+
+def test_requires_serial_backend(pendulum):
+    with pytest.raises(ValueError, match="serial"):
+        SerialBnB(Oracle(pendulum, backend="cpu"))
+
+
+def test_root_bounds_are_lower_bounds(serial, points):
+    bnb = SerialBnB(serial)
+    sol = serial.solve_vertices(points)
+    for i, th in enumerate(points):
+        lbs = bnb.root_bounds(th)
+        conv = sol.conv[i]
+        slack = 1e-6 * np.maximum(1.0, np.abs(sol.V[i][conv]))
+        assert np.all(lbs[conv] <= sol.V[i][conv] + slack), (
+            f"point {i}: root bound above the converged QP optimum")
+
+
+def test_bnb_matches_enumeration(serial, points, pendulum):
+    bnb = SerialBnB(serial)
+    sol = serial.solve_vertices(points)
+    nd = pendulum.canonical.n_delta
+    for i, th in enumerate(points):
+        V, d, n_qp = bnb.solve_point(th)
+        assert n_qp <= nd
+        if np.isfinite(sol.Vstar[i]):
+            assert np.isfinite(V)
+            assert np.isclose(V, sol.Vstar[i], rtol=1e-6, atol=1e-8), (
+                f"point {i}: bnb {V} vs enumeration {sol.Vstar[i]}")
+            # dstar may legitimately differ on exact cost ties; the chosen
+            # commutation's own cost must equal the optimum.
+            assert np.isclose(sol.V[i][d], sol.Vstar[i],
+                              rtol=1e-6, atol=1e-8)
+        else:
+            assert not np.isfinite(V) and d == -1
+
+
+def test_pruning_happens(serial, points, pendulum):
+    """On the pendulum family the unconstrained bounds separate modes
+    well enough that best-first beats flat enumeration on average."""
+    bnb = SerialBnB(serial)
+    stats = bnb.measure(points)
+    assert stats["qp_per_point"] <= pendulum.canonical.n_delta
+    assert stats["pruned_per_point"] > 0, (
+        "no commutation was ever pruned -- bound or ordering is broken")

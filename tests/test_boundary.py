@@ -1,0 +1,148 @@
+"""Semi-explicit boundary closure (cfg.semi_explicit_boundary_depth).
+
+Round-3 verdict item 4: a simplex whose vertices have mixed feasibility
+straddles the feasible set's boundary and can never pass a whole-simplex
+certificate -- the pure 'suboptimal' build splits it to max_depth and
+leaves an uncovered hole.  The closure composes the two algorithm
+variants: at depth >= semi_explicit_boundary_depth such cells close as
+SEMI-EXPLICIT leaves (stored feasible-somewhere commutation + online
+fixed-delta QP), so the build drains with volume fully accounted and the
+certified / semi-explicit split reported separately.
+"""
+
+import numpy as np
+import pytest
+
+from explicit_hybrid_mpc_tpu.config import PartitionConfig
+from explicit_hybrid_mpc_tpu.online import export
+from explicit_hybrid_mpc_tpu.oracle.oracle import Oracle
+from explicit_hybrid_mpc_tpu.partition.frontier import build_partition
+from explicit_hybrid_mpc_tpu.post.analysis import partition_report
+from explicit_hybrid_mpc_tpu.problems.registry import make
+from explicit_hybrid_mpc_tpu.sim.simulator import SemiExplicitController
+
+# A box large enough that the input-constrained finite-horizon QP is
+# infeasible near the corners: the feasible boundary crosses the interior.
+_BOX = 3.0
+
+
+@pytest.fixture(scope="module")
+def problem():
+    return make("mass_spring", N=4, theta_box=_BOX)
+
+
+@pytest.fixture(scope="module")
+def oracle(problem):
+    return Oracle(problem, backend="cpu")
+
+
+def _cfg(**kw):
+    base = dict(problem="mass_spring", eps_a=1.0, eps_r=0.5, backend="cpu",
+                batch_simplices=128, max_depth=12, max_steps=4000)
+    base.update(kw)
+    return PartitionConfig(**base)
+
+
+@pytest.fixture(scope="module")
+def closed_build(problem, oracle):
+    # Closure depth sets the boundary-shell resolution: at 8, a cell
+    # closing semi-explicit has volume 2^-8 of its root, so the shell
+    # stays thin around the feasible boundary instead of swallowing the
+    # (largely infeasible) outer box.
+    return build_partition(problem, _cfg(semi_explicit_boundary_depth=8),
+                           oracle=oracle)
+
+
+def test_boundary_cells_exist(problem, oracle):
+    """Precondition for the whole module: the chosen box actually puts
+    the feasible boundary inside Theta (some vertices infeasible)."""
+    rng = np.random.default_rng(0)
+    pts = rng.uniform(problem.theta_lb, problem.theta_ub, size=(64, 4))
+    sol = oracle.solve_vertices(pts)
+    feas = sol.dstar >= 0
+    assert feas.any() and not feas.all(), (
+        f"box {_BOX} gives {feas.sum()}/64 feasible -- pick a box where "
+        "the feasible boundary crosses the interior")
+
+
+def test_closure_drains_with_boundary_covered(closed_build):
+    """The frontier drains with every boundary cell closed semi-explicit
+    (at this crude eps/depth the INTERIOR may still have depth-cap
+    best-effort leaves -- that is the eps-vs-depth tradeoff, not the
+    boundary feature; the benchmark-scale run drives it to zero)."""
+    stats = closed_build.stats
+    assert not stats["truncated"]
+    assert stats["semi_explicit"] > 0
+    rep = partition_report(closed_build.tree, closed_build.roots)
+    assert rep["n_semi_explicit"] == stats["semi_explicit"]
+    # Large parts of a 3.0 box are infeasible or (at depth 12) best-
+    # effort; the invariant under test is the ACCOUNTING: certified and
+    # semi-explicit volume both exist and are reported separately.
+    assert rep["volume_certified_frac"] > 0.05
+    assert 0.0 < rep["volume_semi_explicit_frac"] < 0.5
+
+
+def test_no_closure_leaves_holes(problem, oracle, closed_build):
+    """The same build WITHOUT the closure burns steps on the boundary
+    shell and ends with uncovered volume at the depth cap (mixed cells
+    have no all-vertex-feasible candidate, so they become holes)."""
+    res = build_partition(problem, _cfg(), oracle=oracle)
+    rep_open = partition_report(res.tree, res.roots)
+    rep_closed = partition_report(closed_build.tree, closed_build.roots)
+    covered_open = (rep_open["volume_certified_frac"]
+                    + rep_open["volume_best_effort_frac"])
+    covered_closed = (rep_closed["volume_certified_frac"]
+                      + rep_closed["volume_best_effort_frac"]
+                      + rep_closed["volume_semi_explicit_frac"])
+    assert covered_closed > covered_open, (
+        "closure must strictly extend guaranteed coverage")
+    assert res.stats["semi_explicit"] == 0
+
+
+def test_semi_explicit_leaves_have_mixed_feasibility(closed_build, oracle):
+    """Each semi-explicit leaf straddles the boundary: its stored
+    commutation converges at >= 1 vertex but not all (that is the only
+    path that creates them)."""
+    tree = closed_build.tree
+    semi = [i for i in tree.converged_leaves()
+            if getattr(tree.leaf_data[i], "semi_explicit", False)]
+    assert semi
+    for n in semi[:10]:
+        sol = oracle.solve_vertices(tree.vertices[n])
+        conv = sol.conv[:, tree.leaf_data[n].delta_idx]
+        assert conv.any() and not conv.all()
+
+
+def test_hybrid_online_path(closed_build, problem, oracle):
+    """Deployment: certified leaves answer by interpolation (no QP);
+    semi-explicit leaves run the online fixed-delta QP, which succeeds on
+    the feasible side of the cell (sampled at converged vertices)."""
+    table = export.export_leaves(closed_build.tree)
+    mask = export.semi_explicit_mask(closed_build.tree, table)
+    assert mask.any() and not mask.all()
+    ctl = SemiExplicitController(table, oracle, semi_mask=mask)
+
+    tree = closed_build.tree
+    cert = [i for i in tree.converged_leaves()
+            if not getattr(tree.leaf_data[i], "semi_explicit", False)
+            and getattr(tree.leaf_data[i], "certified", True)][0]
+    theta_cert = tree.vertices[cert].mean(axis=0)  # interior point
+    before = oracle.n_point_solves
+    u, info = ctl(theta_cert)
+    assert oracle.n_point_solves == before, "certified leaf must not QP"
+    assert info.inside
+
+    semi = [i for i in tree.converged_leaves()
+            if getattr(tree.leaf_data[i], "semi_explicit", False)][0]
+    sol = oracle.solve_vertices(tree.vertices[semi])
+    d = tree.leaf_data[semi].delta_idx
+    v_ok = int(np.where(sol.conv[:, d])[0][0])
+    # STRICTLY inside the cell (a bare vertex is shared with adjacent --
+    # possibly certified -- leaves and point location may pick those),
+    # biased toward the feasible vertex so the online QP has a solution.
+    theta_semi = (0.9 * tree.vertices[semi][v_ok]
+                  + 0.1 * tree.vertices[semi].mean(axis=0))
+    before = oracle.n_point_solves
+    u, info = ctl(theta_semi)
+    assert oracle.n_point_solves > before, "semi-explicit leaf must QP"
+    assert np.all(np.isfinite(u))

@@ -1,0 +1,108 @@
+"""Point-query oracle for SOC-constrained hybrid problems.
+
+The MICP-at-a-point query (reference: P_theta; SURVEY.md section 3
+"Oracle", citation UNVERIFIED -- mount empty) for problems whose
+fixed-commutation subproblem is an SOCP rather than a QP: vmapped
+socp_solve over the (points x commutations) grid with first-minimum
+delta reduction -- the same enumeration-replaces-B&B design as
+oracle.Oracle, restricted to the queries the SOC class currently
+supports (docs/socp_scope.md records the scoping decision):
+
+  - solve_vertices: full MICP at parameter points (V, usable, u0,
+    Vstar, dstar);
+  - solve_fixed: fixed-commutation online solve, mirroring
+    Oracle.solve_fixed's (u0, V, conv, z) arity and the n_solves/
+    n_point_solves counters so sim.SemiExplicitController can deploy it
+    unchanged once an SOC partition exists (the SOC scope itself stops
+    at point queries + closed-loop simulation today).
+
+NOT provided (partition certificates stay QP-only): envelope-theorem
+cost gradients, joint simplex-wide minima, Farkas infeasibility
+certificates.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from explicit_hybrid_mpc_tpu.oracle.socp import socp_solve
+
+
+class SOCPointOracle:
+    def __init__(self, problem, n_iter: int = 60):
+        can = problem.canonical
+        Ac, bc = problem.soc_cones()
+        self.problem = problem
+        self.can = can
+        self.n_delta = can.n_delta
+        self._H = jnp.asarray(can.H)
+        self._f = jnp.asarray(can.f)
+        self._F = jnp.asarray(can.F)
+        self._G = jnp.asarray(can.G)
+        self._w = jnp.asarray(can.w)
+        self._S = jnp.asarray(can.S)
+        self._Y = jnp.asarray(can.Y)
+        self._p = jnp.asarray(can.pvec)
+        self._c = jnp.asarray(can.cconst)
+        self._umap = jnp.asarray(can.u_map)
+        self._utheta = jnp.asarray(can.u_theta)
+        self._uconst = jnp.asarray(can.u_const)
+        self._Ac = jnp.asarray(Ac)
+        self._bc = jnp.asarray(bc)
+        self.n_solves = 0
+        self.n_point_solves = 0
+
+        def solve_one(theta, d):
+            q = self._f[d] + self._F[d] @ theta
+            b = self._w[d] + self._S[d] @ theta
+            sol = socp_solve(self._H[d], q, self._G[d], b,
+                             self._Ac, self._bc, n_iter=n_iter)
+            tc = (0.5 * theta @ self._Y[d] @ theta
+                  + self._p[d] @ theta + self._c[d])
+            u0 = (self._umap[d] @ sol.z + self._utheta[d] @ theta
+                  + self._uconst[d])
+            # `usable` is the value-quality gate for the delta reduction:
+            # a minority of cone instances stall with the primal exact
+            # (rp ~ 1e-16, gap tiny) but the dual residual frozen around
+            # 1e-7 -- their objective is accurate to ~1e-5 relative,
+            # which is what the POINT-QUERY scope needs (docs/
+            # socp_scope.md; the eps-certificate path, which would need
+            # certified bounds, is QP-only).  `conv` stays the strict
+            # 1e-8 KKT flag.
+            usable = sol.converged | (sol.feasible & (sol.gap < 1e-5)
+                                      & (sol.rd < 1e-4))
+            return sol.obj + tc, sol.converged, usable, u0, sol.z
+
+        self._grid = jax.jit(jax.vmap(lambda th: jax.vmap(
+            lambda d: solve_one(th, d))(jnp.arange(can.n_delta))))
+        self._fixed = jax.jit(jax.vmap(solve_one))
+
+    def solve_vertices(self, thetas: np.ndarray):
+        """(V, usable, u0, Vstar, dstar) over the full commutation grid;
+        first-minimum tie-break over USABLE values (deterministic,
+        matching oracle.reduce_deltas)."""
+        thetas = jnp.asarray(np.atleast_2d(thetas))
+        V, conv, usable, u0, _z = self._grid(thetas)
+        self.n_solves += int(thetas.shape[0]) * self.n_delta
+        self.n_point_solves += int(thetas.shape[0]) * self.n_delta
+        Vval = jnp.where(usable, V, jnp.inf)
+        dstar = jnp.argmin(Vval, axis=-1)
+        Vstar = jnp.take_along_axis(Vval, dstar[:, None], axis=-1)[:, 0]
+        dstar = jnp.where(jnp.isfinite(Vstar), dstar, -1)
+        return (np.asarray(V), np.asarray(usable), np.asarray(u0),
+                np.asarray(Vstar), np.asarray(dstar))
+
+    def solve_fixed(self, thetas: np.ndarray, delta_idx: np.ndarray):
+        """Online fixed-commutation SOCP (semi-explicit deployment):
+        (u0, V, conv, z) with conv = the usable-quality flag (see
+        solve_vertices) -- Oracle.solve_fixed's arity."""
+        thetas = jnp.asarray(np.atleast_2d(thetas))
+        ds = jnp.asarray(np.atleast_1d(delta_idx).astype(np.int64))
+        V, conv, usable, u0, z = self._fixed(thetas, ds)
+        self.n_solves += int(thetas.shape[0])
+        self.n_point_solves += int(thetas.shape[0])
+        return (np.asarray(u0), np.asarray(V), np.asarray(usable),
+                np.asarray(z))

@@ -737,6 +737,16 @@ class FrontierEngine:
 
                 jax.profiler.stop_trace()
         wall = time.perf_counter() - t0
+        stats = self.stats_dict(wall)
+        self.log.emit(done=True, **stats)
+        return PartitionResult(self.tree, self.roots, stats)
+
+    def stats_dict(self, wall: float) -> dict:
+        """The run-summary statistics dict for the build so far.
+
+        Factored out of run() so external drivers (scripts/long_build.py
+        runs its own step loop to support pause/resume around TPU capture
+        windows) report the IDENTICAL schema."""
         stats = {
             "regions": self.tree.n_regions(),
             "tree_nodes": len(self.tree),
@@ -780,8 +790,7 @@ class FrontierEngine:
             "cache_peak_mb": round(self.cache.peak_bytes / 2**20, 2),
             "cache_live_vertices": len(self.cache),
         }
-        self.log.emit(done=True, **stats)
-        return PartitionResult(self.tree, self.roots, stats)
+        return stats
 
     # -- checkpoint / resume (SURVEY.md section 6.4) -----------------------
 

@@ -8,7 +8,7 @@ import importlib
 _REGISTRY: dict[str, type] = {}
 
 _MODULES = ("double_integrator", "mass_spring", "inverted_pendulum",
-            "satellite", "quadrotor")
+            "satellite", "satellite_soc", "quadrotor")
 
 
 def register(cls):

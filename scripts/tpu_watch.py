@@ -200,34 +200,53 @@ def run_capture(name: str, script: str, env_extra: dict, timeout: float) -> bool
     attempt = os.path.join(ART, stem + ".attempt.log")
     outcome = "completed"
     os.makedirs(ART, exist_ok=True)
-    with open(attempt, "w") as lf:
-        child = subprocess.Popen([sys.executable, script], cwd=REPO,
-                                 env=env, stdout=lf,
-                                 stderr=subprocess.STDOUT)
-        t0 = time.time()
-        while child.poll() is None:
-            time.sleep(20)
-            now = time.time()
-            if now - t0 > timeout:
-                log(f"  {name}: TIMED OUT after {timeout}s")
-                outcome = "timeout"
-                child.kill()
-                child.wait()
-                break
-            last = max(_progress_mtime(name), t0)
-            if now - last > stall_s:
-                log(f"  {name}: STALLED ({stall_s}s with no file "
-                    "progress); killing")
-                outcome = "stall-killed"
-                child.terminate()
+    # Capture-active sentinel: the host has ONE core, and the TPU capture
+    # scripts measure their serial-CPU baselines on it -- a background
+    # long build running concurrently would inflate those latencies and
+    # overstate vs_baseline.  scripts/long_build.py pauses while this
+    # file exists.
+    sentinel = os.path.join(ART, ".capture_active")
+    open(sentinel, "w").close()
+    try:
+        with open(attempt, "w") as lf:
+            child = subprocess.Popen([sys.executable, script], cwd=REPO,
+                                     env=env, stdout=lf,
+                                     stderr=subprocess.STDOUT)
+            t0 = time.time()
+            while child.poll() is None:
+                time.sleep(20)
+                # Heartbeat: long_build treats a sentinel with a stale
+                # mtime as orphaned (a SIGKILLed watcher cannot unlink).
                 try:
-                    child.wait(timeout=20)
-                except subprocess.TimeoutExpired:
+                    os.utime(sentinel)
+                except OSError:
+                    pass
+                now = time.time()
+                if now - t0 > timeout:
+                    log(f"  {name}: TIMED OUT after {timeout}s")
+                    outcome = "timeout"
                     child.kill()
                     child.wait()
-                break
-        if outcome == "completed" and child.returncode != 0:
-            outcome = f"exit {child.returncode}"
+                    break
+                last = max(_progress_mtime(name), t0)
+                if now - last > stall_s:
+                    log(f"  {name}: STALLED ({stall_s}s with no file "
+                        "progress); killing")
+                    outcome = "stall-killed"
+                    child.terminate()
+                    try:
+                        child.wait(timeout=20)
+                    except subprocess.TimeoutExpired:
+                        child.kill()
+                        child.wait()
+                    break
+            if outcome == "completed" and child.returncode != 0:
+                outcome = f"exit {child.returncode}"
+    finally:
+        try:
+            os.unlink(sentinel)
+        except OSError:
+            pass
     plat = artifact_platform(name, dict(zip([c[0] for c in CAPTURES],
                                             [c[4] for c in CAPTURES]))[name])
     # Success criterion MUST match needed()'s (artifact platform), or a

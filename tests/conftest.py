@@ -48,6 +48,8 @@ import pytest  # noqa: E402
 # adding heavy tests.
 _SLOW = (
     "test_boundary.py::",
+    "test_socp.py::",
+    "test_satellite_soc.py::",
     "test_capture_scripts.py::",
     "test_cli.py::",
     "test_distributed.py::",

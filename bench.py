@@ -155,6 +155,16 @@ def choose_backend(result: dict | None = None) -> str:
     return platform
 
 
+def default_precision(on_acc: bool) -> str:
+    """Platform-dependent IPM precision default, shared by every
+    benchmark driver: 'mixed' exists to dodge TPU f64 emulation; on CPU
+    f64 is native and mixed is a measured LOSS (flagship 0.91x; on the
+    quadrotor the rejected f32 phase left 60% of point solves
+    unconverged, forcing thousands of stage-2 joint QPs -- 4x slower
+    end-to-end, r4 A/B artifacts/quad_prune_ab_cpu.json)."""
+    return "mixed" if on_acc else "f64"
+
+
 def retry_transient(fn, attempts: int = 3, wait_s: float = 20.0,
                     what: str = ""):
     """Run fn(), retrying transient device/tunnel errors (the axon remote-
@@ -321,7 +331,8 @@ def run(result: dict) -> None:
     problem_name = ("inverted_pendulum" if "inverted_pendulum" in names()
                     else "double_integrator")
     problem_name = os.environ.get("BENCH_PROBLEM", problem_name)
-    precision = os.environ.get("BENCH_PRECISION", "mixed")
+    precision = os.environ.get("BENCH_PRECISION",
+                               default_precision(on_acc))
     problem = make(problem_name)
     eps_a = float(os.environ.get("BENCH_EPS", "1e-2"))
 

@@ -108,6 +108,12 @@ class PartitionConfig:
     # disables (pure variant behavior).  Reported separately from
     # certified volume (post.analysis, stats['semi_explicit']).
     semi_explicit_boundary_depth: Optional[int] = None
+    # Prune constraint rows (and decoupled slack vars) that a sampled
+    # solve shows never active on the box, with per-instance KKT-verified
+    # fallback to the full problem (oracle/prune.py).  Point-class
+    # programs only; exact by construction.  Big win on row-heavy
+    # configs (quadrotor: 360 -> ~100 rows); off by default.
+    prune_rows: bool = False
     seed: int = 0
 
     def __post_init__(self) -> None:

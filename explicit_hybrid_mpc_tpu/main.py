@@ -53,6 +53,9 @@ def build_parser() -> argparse.ArgumentParser:
                    "at depth >= D as semi-explicit boundary leaves "
                    "(online fixed-delta QP) instead of splitting to "
                    "--max-depth; closes the feasible-set boundary shell")
+    p.add_argument("--prune-rows", action="store_true",
+                   help="prune never-active constraint rows with "
+                   "KKT-verified per-solve fallback (row-heavy configs)")
     p.add_argument("--max-steps", type=int, default=10_000)
     p.add_argument("--checkpoint-every", type=int, default=0, metavar="K",
                    help="snapshot frontier+tree every K steps")
@@ -136,6 +139,7 @@ def main(argv: list[str] | None = None) -> int:
         algorithm=args.algorithm, backend=args.backend,
         batch_simplices=args.batch, max_depth=args.max_depth,
         semi_explicit_boundary_depth=args.boundary_depth,
+        prune_rows=args.prune_rows,
         max_steps=args.max_steps,
         checkpoint_every=args.checkpoint_every,
         checkpoint_path=(f"{prefix}.ckpt.pkl"

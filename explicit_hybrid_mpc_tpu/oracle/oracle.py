@@ -269,7 +269,10 @@ def _solve_simplex_min_one(prob: DeviceProblem, bary_M: jax.Array,
     # t = 0 is a feasible point of the HARD problem on R, so t <= tol
     # proves feasibility-somewhere without a separate phase-1 solve
     # (solve_simplex_min runs phase-1 only when t suggests otherwise).
-    return obj + prob.cconst[d], sol.converged, sol.feasible, t_elastic
+    # The joint primal is returned so the pruned oracle can verify its
+    # dropped rows at the witness (oracle/prune.py).
+    return obj + prob.cconst[d], sol.converged, sol.feasible, t_elastic, \
+        sol.z
 
 
 class Oracle:
@@ -379,9 +382,19 @@ class Oracle:
         # single-commutation problems.
         if stage2_order not in ("auto", "min_first", "phase1_first"):
             raise ValueError(f"unknown stage2_order {stage2_order!r}")
-        self.stage2_phase1_first = (self.can.n_delta > 1
-                                    if stage2_order == "auto"
-                                    else stage2_order == "phase1_first")
+        # 'auto' honors a problem-declared hint first: problems whose
+        # commutations are feasible EVERYWHERE (softened rows -- the
+        # quadrotor) make the hybrid phase1-first default pure overhead,
+        # since phase-1 never excludes anything and every row still runs
+        # the elastic min (measured: ~2x the joint-QP volume).
+        hint = getattr(problem, "stage2_hint", None)
+        if stage2_order == "auto" and hint in ("min_first",
+                                               "phase1_first"):
+            self.stage2_phase1_first = hint == "phase1_first"
+        else:
+            self.stage2_phase1_first = (self.can.n_delta > 1
+                                        if stage2_order == "auto"
+                                        else stage2_order == "phase1_first")
         if backend in ("tpu", "gpu", "device"):
             platform = None  # default platform (the accelerator if present)
         elif backend in ("cpu", "serial"):
@@ -726,7 +739,7 @@ class Oracle:
         self.n_solves += idx.size
         self.n_simplex_solves += idx.size
         Mj, dj = self._pad_simplex(Ms[idx], ds[idx])
-        V, conv, _feas, t_el = self._simplex_min(Mj, dj)
+        V, conv, _feas, t_el, _zj = self._simplex_min(Mj, dj)
         V = np.asarray(V)[:idx.size]
         conv = np.asarray(conv)[:idx.size]
         t_el = np.asarray(t_el)[:idx.size]

@@ -30,13 +30,16 @@ coneqp/ECOS derivations; no reference code exists for this -- the
 reference delegates SOCPs to Gurobi/MOSEK behind cvxpy [SURVEY section 2
 L0, mount empty]):
 
-For s, lam in int(SOC) the NT scaling W = eta * H(wbar) with
-H(w) = 2 w w' - J, J = diag(1, -I), wbar the normalized geometric mean
-of sbar = s/sqrt(det s), lbar = lam/sqrt(det lam):
+For s, lam in int(SOC) the NT scaling is W = eta * V(wbar), where
+V(w) = [[w0, w1'], [w1, I + w1 w1'/(1+w0)]] satisfies V(w)^2 = 2 w w' - J
+= P(w) (the quadratic representation; J = diag(1, -I)), and wbar is the
+normalized NT point of the pair:
     gamma = sqrt((1 + sbar'lbar) / 2)
-    wbar  = (lbar + J sbar) / (2 gamma)          (wbar' J wbar = 1)
-    eta   = (det lam / det s)^{1/4},  det u = u0^2 - ||u1||^2.
-W lam = W^{-1} s = v (the scaled point).  Newton direction for target
+    wbar  = (sbar + J lbar) / (2 gamma)          (det wbar = 1)
+    eta   = (det s / det lam)^{1/4},  det u = u0^2 - ||u1||^2,
+with sbar = s/sqrt(det s), lbar = lam/sqrt(det lam).
+Then W lam = W^{-1} s = v (the scaled point) -- see _nt_scaling, whose
+docstring and tests/test_socp.py pin this convention numerically.  Newton direction for target
 complementarity d_c (Jordan product o, Arw(u) x = u o x):
     v o (W^{-1} ds + W dlam) = d_c
     ds = W (v^{-1} o d_c) - W^2 dlam
@@ -136,9 +139,15 @@ def _cone_step(s, ds, tau=0.995):
     sq = jnp.sqrt(disc)
     # Roots of a t^2 + b t + c = 0; the boundary is the smallest positive
     # root of det(s + t ds) = 0 intersected with s0 + t ds0 >= 0.
-    r1 = jnp.where(jnp.abs(a) > _TINY, (-b - sq) / (2 * jnp.where(
-        jnp.abs(a) > _TINY, a, 1.0)), -c / jnp.where(
-            jnp.abs(b) > _TINY, b, -1.0))
+    # Degenerate cases: a ~ 0 -> linear root -c/b; a AND b ~ 0 -> the
+    # direction never touches this cone's boundary (det constant at
+    # c > 0): NO cap, not the spurious det(s) a -c/-1 fallback would
+    # inject (a padded/dummy cone would otherwise clamp every step).
+    r1 = jnp.where(jnp.abs(a) > _TINY,
+                   (-b - sq) / (2 * jnp.where(jnp.abs(a) > _TINY, a, 1.0)),
+                   jnp.where(jnp.abs(b) > _TINY,
+                             -c / jnp.where(jnp.abs(b) > _TINY, b, 1.0),
+                             jnp.inf))
     r2 = jnp.where(jnp.abs(a) > _TINY, (-b + sq) / (2 * jnp.where(
         jnp.abs(a) > _TINY, a, 1.0)), jnp.inf)
     t0 = jnp.where(ds[0] < 0, -s[0] / jnp.where(ds[0] < 0, ds[0], -1.0),

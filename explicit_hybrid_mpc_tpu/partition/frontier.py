@@ -885,9 +885,15 @@ class FrontierEngine:
 def build_partition(problem, cfg: PartitionConfig,
                     oracle: Oracle | None = None) -> PartitionResult:
     """One-call offline build: problem + config -> certified partition."""
-    oracle = oracle or Oracle(
-        problem, backend=cfg.backend, precision=cfg.precision,
-        point_schedule=getattr(cfg, "ipm_point_schedule", None),
-        rescue_iter=getattr(cfg, "ipm_rescue_iters", 0))
+    if oracle is None:
+        kw = dict(backend=cfg.backend, precision=cfg.precision,
+                  point_schedule=getattr(cfg, "ipm_point_schedule", None),
+                  rescue_iter=getattr(cfg, "ipm_rescue_iters", 0))
+        if getattr(cfg, "prune_rows", False) and cfg.backend != "serial":
+            from explicit_hybrid_mpc_tpu.oracle.prune import PrunedOracle
+
+            oracle = PrunedOracle(problem, **kw)
+        else:
+            oracle = Oracle(problem, **kw)
     log = RunLog(cfg.log_path, echo=False)
     return FrontierEngine(problem, oracle, cfg, log).run()

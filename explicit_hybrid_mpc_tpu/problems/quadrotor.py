@@ -56,6 +56,12 @@ _FACES = ((-1, 0), (+1, 0), (-1, 1), (+1, 1))
 @register
 class Quadrotor(base.HybridMPC):
     name = "quadrotor"
+    # Every commutation is feasible everywhere (the avoidance rows are
+    # softened), so stage-2's hybrid phase1-first default would run a
+    # 360-row joint phase-1 per pair that never excludes anything;
+    # min-first lets the elastic minimum's own t=0 witness prove
+    # feasibility and reserves phase-1 for the (empty) remainder.
+    stage2_hint = "min_first"
 
     def __init__(self, N: int = 10, dt: float = 0.25, mass: float = 1.0,
                  g: float = 9.81, J=(0.01, 0.01, 0.02),

@@ -64,7 +64,6 @@ CONFIGS = [
 
 def main() -> int:
     out_path = os.environ.get("CONFIGS_OUT", "artifacts/configs.json")
-    precision = os.environ.get("CFG_PRECISION", "mixed")
     budget = float(os.environ.get("CFG_TIME_BUDGET")
                    or os.environ.get("CONFIGS_TIME_BUDGET")  # tpu_watch name
                    or "600")
@@ -72,11 +71,14 @@ def main() -> int:
     only_names = set(only.split(",")) if only else None
 
     result = {"captured_at": time.strftime("%Y-%m-%d %H:%M:%S"),
-              "precision": precision,
               "per_config_budget_s": budget, "rows": []}
     # Probe flags land in the artifact (round-2 advisor item).
     platform = choose_backend(result)
     on_acc = platform != "cpu"
+    from bench import default_precision
+
+    precision = os.environ.get("CFG_PRECISION", default_precision(on_acc))
+    result["precision"] = precision
 
     from explicit_hybrid_mpc_tpu.config import PartitionConfig
     from explicit_hybrid_mpc_tpu.oracle.oracle import Oracle
@@ -89,9 +91,18 @@ def main() -> int:
         log(f"== {label} ==")
         try:
             problem = make(name, **kwargs)
-            oracle = Oracle(problem, backend="device" if on_acc else "cpu",
-                            precision=precision,
-                            points_cap=2048 if on_acc else 256)
+            okw = dict(backend="device" if on_acc else "cpu",
+                       precision=precision,
+                       points_cap=2048 if on_acc else 256)
+            if name == "quadrotor":
+                # Measured r4 (row 5b, f64, warm): 2.87x regions/s at the
+                # identical 1208-region tree, 54 verified fallbacks.
+                from explicit_hybrid_mpc_tpu.oracle.prune import \
+                    PrunedOracle
+
+                oracle = PrunedOracle(problem, **okw)
+            else:
+                oracle = Oracle(problem, **okw)
             # Warm the jit buckets (excluded from the timed build).
             warm_oracle(oracle, problem)
             warm_cfg = PartitionConfig(problem=name, eps_a=1.0,

@@ -155,14 +155,19 @@ def choose_backend(result: dict | None = None) -> str:
     return platform
 
 
-def default_precision(on_acc: bool) -> str:
-    """Platform-dependent IPM precision default, shared by every
-    benchmark driver: 'mixed' exists to dodge TPU f64 emulation; on CPU
-    f64 is native and mixed is a measured LOSS (flagship 0.91x; on the
-    quadrotor the rejected f32 phase left 60% of point solves
-    unconverged, forcing thousands of stage-2 joint QPs -- 4x slower
-    end-to-end, r4 A/B artifacts/quad_prune_ab_cpu.json)."""
-    return "mixed" if on_acc else "f64"
+def default_precision(on_acc: bool, problem=None) -> str:
+    """IPM precision default, shared by every benchmark driver.
+
+    'mixed' dodges TPU f64 emulation and wins on problems whose short
+    f64 polish converges (pendulum CPU warm: 498 r/s mixed vs 385 f64,
+    and mixed reproduces the canonical 11,973-region tree).  Problems
+    whose f32 phase collapses declare cpu_precision_hint='f64'
+    (quadrotor: 60% of point solves unconverged under mixed, forcing
+    ~10k phantom stage-2 joint QPs -- 4x slower end-to-end; r4 A/B in
+    artifacts/quad_prune_ab_cpu.json)."""
+    if on_acc:
+        return "mixed"
+    return getattr(problem, "cpu_precision_hint", "mixed")
 
 
 def retry_transient(fn, attempts: int = 3, wait_s: float = 20.0,
@@ -331,9 +336,9 @@ def run(result: dict) -> None:
     problem_name = ("inverted_pendulum" if "inverted_pendulum" in names()
                     else "double_integrator")
     problem_name = os.environ.get("BENCH_PROBLEM", problem_name)
-    precision = os.environ.get("BENCH_PRECISION",
-                               default_precision(on_acc))
     problem = make(problem_name)
+    precision = os.environ.get("BENCH_PRECISION",
+                               default_precision(on_acc, problem))
     eps_a = float(os.environ.get("BENCH_EPS", "1e-2"))
 
     # Platform-scaled knobs: the CPU fallback must finish inside the
@@ -356,9 +361,27 @@ def run(result: dict) -> None:
     # tolerance (TPU f64 is emulated ~10x slower); the serial baseline
     # below uses the SAME schedule, so the speedup isolates batching.
     sched_kw = schedule_kwargs(result)
-    oracle = Oracle(problem, backend="device" if on_acc else "cpu",
-                    precision=precision, points_cap=points_cap,
-                    **sched_kw)
+    # Constraint pruning (oracle/prune.py): defaults from the problem's
+    # own hint -- a clear win only on row-heavy configs (quadrotor:
+    # 2.87x under f64); on the 35-row flagship it is a wash under the
+    # mixed schedule (507 vs 498 r/s warm) and perturbs the canonical
+    # region count, so the flagship benchmark keeps the plain oracle.
+    # BENCH_PRUNE=0/1 overrides; accelerator default stays off until
+    # the extra host-device syncs are measured on-chip.
+    prune = os.environ.get("BENCH_PRUNE")
+    prune_on = ((prune == "1") if prune else
+                (not on_acc and getattr(problem, "prune_hint", False)))
+    result["prune_rows"] = prune_on
+    if prune_on:
+        from explicit_hybrid_mpc_tpu.oracle.prune import PrunedOracle
+
+        oracle = PrunedOracle(problem, backend="device" if on_acc
+                              else "cpu", precision=precision,
+                              points_cap=points_cap, **sched_kw)
+    else:
+        oracle = Oracle(problem, backend="device" if on_acc else "cpu",
+                        precision=precision, points_cap=points_cap,
+                        **sched_kw)
     # Warm the jit caches so compile time is excluded: the bucket sweep,
     # then a tiny build for the simplex-query programs.
     warm_reserve = time_budget + 120.0  # leave room for build + baseline
@@ -409,7 +432,12 @@ def run(result: dict) -> None:
     # -- serial-oracle baseline estimate -----------------------------------
     # Point QPs and joint simplex QPs are structurally different sizes:
     # time each kind separately and weight by the counts the batched run
-    # actually issued.
+    # actually issued.  The serial stand-in always solves the FULL-row
+    # problem (PrunedOracle rejects backend='serial' by design): when
+    # prune_rows is on, vs_baseline therefore measures batching PLUS the
+    # pruning engine against the reference's one-full-QP-at-a-time
+    # loop -- the real-world comparison -- and the definition strings
+    # say so.
     serial = Oracle(problem, backend="serial", precision=precision,
                     **sched_kw)
     per_solve, per_simplex = measure_serial_latencies(
@@ -428,9 +456,12 @@ def run(result: dict) -> None:
                   # accelerator, and artifacts/north_star*.json carry
                   # the measured end-to-end serial parity builds.
                   baseline_definition=(
-                      "measured serial per-QP latency x issued QP "
-                      "counts / batched wall; conservative (vmap-"
-                      "amortized serial timing)"))
+                      "measured serial FULL-ROW per-QP latency x issued "
+                      "QP counts / batched wall; conservative (vmap-"
+                      "amortized serial timing)"
+                      + ("; batched side ran the pruned oracle, so the "
+                         "ratio includes the pruning engine, not "
+                         "batching alone" if prune_on else "")))
 
     # -- B&B-style serial baseline (round-3 verdict item 8) ----------------
     # The reference's serial oracle is a branch-and-bound MICP per vertex;

@@ -62,6 +62,15 @@ class Quadrotor(base.HybridMPC):
     # min-first lets the elastic minimum's own t=0 witness prove
     # feasibility and reserves phase-1 for the (empty) remainder.
     stage2_hint = "min_first"
+    # The mixed schedule's f32 phase collapses on this problem (60% of
+    # point solves unconverged after the short f64 polish, r4 A/B in
+    # artifacts/quad_prune_ab_cpu.json): CPU benchmark drivers should
+    # run full f64 (4x faster end-to-end); TPU keeps mixed (emulated
+    # f64 changes the tradeoff -- to be re-measured on-chip).
+    cpu_precision_hint = "f64"
+    # Row-heavy config (nc=360): benchmark drivers should use the
+    # pruned oracle on CPU (measured 2.87x at the identical tree).
+    prune_hint = True
 
     def __init__(self, N: int = 10, dt: float = 0.25, mass: float = 1.0,
                  g: float = 9.81, J=(0.01, 0.01, 0.02),

@@ -77,8 +77,8 @@ def main() -> int:
     on_acc = platform != "cpu"
     from bench import default_precision
 
-    precision = os.environ.get("CFG_PRECISION", default_precision(on_acc))
-    result["precision"] = precision
+    forced_precision = os.environ.get("CFG_PRECISION")
+    result["precision"] = forced_precision or "per-problem default"
 
     from explicit_hybrid_mpc_tpu.config import PartitionConfig
     from explicit_hybrid_mpc_tpu.oracle.oracle import Oracle
@@ -91,12 +91,15 @@ def main() -> int:
         log(f"== {label} ==")
         try:
             problem = make(name, **kwargs)
+            precision = forced_precision or default_precision(on_acc,
+                                                              problem)
             okw = dict(backend="device" if on_acc else "cpu",
                        precision=precision,
                        points_cap=2048 if on_acc else 256)
-            if name == "quadrotor":
-                # Measured r4 (row 5b, f64, warm): 2.87x regions/s at the
-                # identical 1208-region tree, 54 verified fallbacks.
+            if not on_acc and getattr(problem, "prune_hint", False):
+                # Same policy as bench.py: the problem's own hint, CPU
+                # only.  Measured r4 (quadrotor row 5b, f64, warm):
+                # 2.87x regions/s at the identical 1208-region tree.
                 from explicit_hybrid_mpc_tpu.oracle.prune import \
                     PrunedOracle
 
@@ -123,7 +126,7 @@ def main() -> int:
             report = analysis.partition_report(res.tree, res.roots)
             row = {
                 "label": label, "problem": name, "kwargs": kwargs,
-                "eps_a": eps_a, "eps_r": eps_r,
+                "eps_a": eps_a, "eps_r": eps_r, "precision": precision,
                 "n_theta": problem.n_theta,
                 "n_delta": problem.canonical.n_delta,
                 "regions": stats["regions"],

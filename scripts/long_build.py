@@ -18,10 +18,13 @@ scale on MPI clusters, SURVEY.md section 1 [P]) with:
   time and online us/query at final scale -- the verdict's required
   evidence fields.
 
-Env: LONG_EPS (default 5e-4), LONG_TARGET_REGIONS (default 1.05e6: stop
-once certified regions pass this; 0 = run to drain), LONG_BUDGET_S
-(default 21000), LONG_PROBLEM (default inverted_pendulum), LONG_OUT,
-LONG_CKPT, LONG_CKPT_EVERY (steps, default 1000), LONG_BATCH.
+Env: LONG_EPS (default 5e-4), LONG_EPS_R (default 0), LONG_TARGET_REGIONS
+(default 1.05e6: stop once certified regions pass this; 0 = run to
+drain), LONG_BUDGET_S (default 21000), LONG_PROBLEM (default
+inverted_pendulum), LONG_PROBLEM_ARGS (JSON dict), LONG_OUT, LONG_CKPT,
+LONG_CKPT_EVERY (steps, default 1000), LONG_BATCH, LONG_MAX_DEPTH
+(default 64), LONG_BOUNDARY_DEPTH (semi-explicit closure depth, default
+off), LONG_PRECISION (default bench.default_precision).
 """
 
 from __future__ import annotations
@@ -49,14 +52,21 @@ def write_out(path: str, result: dict) -> None:
 
 def run(result: dict, out_path: str) -> None:
     eps_a = float(os.environ.get("LONG_EPS", "5e-4"))
+    eps_r = float(os.environ.get("LONG_EPS_R", "0"))
     target = float(os.environ.get("LONG_TARGET_REGIONS", "1.05e6"))
     budget = float(os.environ.get("LONG_BUDGET_S", "21000"))
     problem_name = os.environ.get("LONG_PROBLEM", "inverted_pendulum")
+    problem_args = json.loads(os.environ.get("LONG_PROBLEM_ARGS", "{}"))
     ckpt = os.environ.get("LONG_CKPT",
                           os.path.join(ART, "long_build.ckpt.pkl"))
     ckpt_every = int(os.environ.get("LONG_CKPT_EVERY", "1000"))
     batch = int(os.environ.get("LONG_BATCH", "1024"))
+    max_depth = int(os.environ.get("LONG_MAX_DEPTH", "64"))
+    bd_env = os.environ.get("LONG_BOUNDARY_DEPTH")
+    boundary_depth = int(bd_env) if bd_env else None
     platform = choose_backend(result)
+
+    from bench import default_precision
 
     from explicit_hybrid_mpc_tpu.config import PartitionConfig
     from explicit_hybrid_mpc_tpu.oracle.oracle import Oracle
@@ -64,23 +74,51 @@ def run(result: dict, out_path: str) -> None:
     from explicit_hybrid_mpc_tpu.problems.registry import make
     from explicit_hybrid_mpc_tpu.utils.logging import RunLog
 
-    problem = make(problem_name)
-    result.update(problem=problem_name, eps_a=eps_a,
+    problem = make(problem_name, **problem_args)
+    # Precision AFTER make(): the per-problem cpu_precision_hint must
+    # reach a multi-hour campaign (quadrotor under mixed on CPU is the
+    # documented 4x pathology).
+    precision = os.environ.get("LONG_PRECISION",
+                               default_precision(platform != "cpu",
+                                                 problem))
+    result.update(problem=problem_name, problem_args=problem_args,
+                  eps_a=eps_a, eps_r=eps_r, precision=precision,
                   target_regions=target, budget_s=budget,
+                  boundary_depth=boundary_depth,
                   checkpoint_every=ckpt_every, progress=[])
     sched_kw = schedule_kwargs(result)
     cfg = PartitionConfig(
-        problem=problem_name, eps_a=eps_a, backend="device",
-        batch_simplices=batch, max_steps=10_000_000, max_depth=64,
-        precision="mixed",
+        problem=problem_name,
+        problem_args=tuple(sorted(problem_args.items())),
+        eps_a=eps_a, eps_r=eps_r, backend="device",
+        batch_simplices=batch, max_steps=10_000_000, max_depth=max_depth,
+        semi_explicit_boundary_depth=boundary_depth,
+        precision=precision,
         log_path=out_path.replace(".json", ".log.jsonl"))
     oracle = Oracle(problem, backend="device" if platform != "cpu"
-                    else "cpu", precision="mixed", **sched_kw)
+                    else "cpu", precision=precision, **sched_kw)
     runlog = RunLog(cfg.log_path, echo=False)
     base_wall = 0.0
     if os.path.exists(ckpt):
         log(f"resuming from {ckpt}")
-        eng = FrontierEngine.resume(ckpt, problem, oracle, log=runlog,
+        import pickle
+
+        with open(ckpt, "rb") as f:
+            snap = pickle.load(f)
+        # HARD compatibility check: a stale checkpoint at the default
+        # path combined with changed LONG_* knobs would silently
+        # continue a tree certified under DIFFERENT settings.
+        sc = snap["cfg"]
+        for fld in ("problem", "problem_args", "eps_a", "eps_r",
+                    "precision", "semi_explicit_boundary_depth"):
+            snap_v = getattr(sc, fld, None)
+            cfg_v = getattr(cfg, fld, None)
+            if snap_v != cfg_v:
+                raise SystemExit(
+                    f"checkpoint {ckpt} was built with {fld}={snap_v!r} "
+                    f"but this run requests {cfg_v!r}; move the "
+                    "checkpoint aside or match the knobs")
+        eng = FrontierEngine.resume(snap, problem, oracle, log=runlog,
                                     cfg=cfg)
         result["resumed_from_step"] = eng.steps
         # Cumulative build wall from the PREVIOUS sessions' artifact:

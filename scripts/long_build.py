@@ -95,8 +95,18 @@ def run(result: dict, out_path: str) -> None:
         semi_explicit_boundary_depth=boundary_depth,
         precision=precision,
         log_path=out_path.replace(".json", ".log.jsonl"))
-    oracle = Oracle(problem, backend="device" if platform != "cpu"
-                    else "cpu", precision=precision, **sched_kw)
+    okw = dict(backend="device" if platform != "cpu" else "cpu",
+               precision=precision, **sched_kw)
+    # Same policy as bench.py / bench_configs.py: the problem's own
+    # pruning hint, CPU only (exact by per-instance KKT verification).
+    if platform == "cpu" and getattr(problem, "prune_hint", False):
+        from explicit_hybrid_mpc_tpu.oracle.prune import PrunedOracle
+
+        oracle = PrunedOracle(problem, **okw)
+        result["prune_rows"] = True
+    else:
+        oracle = Oracle(problem, **okw)
+        result["prune_rows"] = False
     runlog = RunLog(cfg.log_path, echo=False)
     base_wall = 0.0
     if os.path.exists(ckpt):

@@ -123,8 +123,8 @@ def main(argv: list[str] | None = None) -> int:
         jax.config.update("jax_platforms", "cpu")
 
     from explicit_hybrid_mpc_tpu.config import PartitionConfig
-    from explicit_hybrid_mpc_tpu.oracle.oracle import Oracle
-    from explicit_hybrid_mpc_tpu.partition.frontier import FrontierEngine
+    from explicit_hybrid_mpc_tpu.partition.frontier import (FrontierEngine,
+                                                            make_oracle)
     from explicit_hybrid_mpc_tpu.utils.logging import RunLog
 
     problem_args = _parse_problem_args(args.problem_arg)
@@ -174,7 +174,7 @@ def main(argv: list[str] | None = None) -> int:
                     "algorithm", "backend", "precision",
                     "ipm_point_schedule", "ipm_rescue_iters",
                     "batch_simplices", "max_depth",
-                    "semi_explicit_boundary_depth"):
+                    "semi_explicit_boundary_depth", "prune_rows"):
             cli_v = getattr(cfg, fld)
             # default: pre-problem_args snapshots lack the field
             snap_v = getattr(snap_cfg, fld, cli_v)
@@ -197,14 +197,15 @@ def main(argv: list[str] | None = None) -> int:
     if args.mesh:
         from explicit_hybrid_mpc_tpu.parallel import make_mesh
         mesh = make_mesh((args.mesh, 1))
-    backend = "device" if cfg.backend == "tpu" else cfg.backend
     # Solver schedule knobs come from the FINAL cfg too: resuming with a
     # different schedule than the snapshot's would silently change conv
-    # patterns mid-build (resumed-equals-straight parity).
-    oracle = Oracle(problem, backend=backend, mesh=mesh,
-                    precision=cfg.precision,
-                    point_schedule=getattr(cfg, "ipm_point_schedule", None),
-                    rescue_iter=getattr(cfg, "ipm_rescue_iters", 0))
+    # patterns mid-build (resumed-equals-straight parity).  make_oracle
+    # is the ONE oracle-choice path (shared with build_partition);
+    # strict surfaces the prune-rows/backend conflict as a CLI error.
+    try:
+        oracle = make_oracle(problem, cfg, mesh=mesh, strict=True)
+    except ValueError as e:
+        raise SystemExit(str(e))
     log = RunLog(cfg.log_path, echo=True)
     if args.resume:
         eng = FrontierEngine.resume(snapshot, problem, oracle, log, cfg=cfg)
@@ -227,9 +228,14 @@ def main(argv: list[str] | None = None) -> int:
         theta0 = 0.8 * problem.theta_ub
         # Feasibility-only partitions deploy semi-explicitly: the leaf
         # fixes delta and a small convex QP runs online (SURVEY.md 4.2).
+        # Hybrid builds (--boundary-depth) carry semi-explicit BOUNDARY
+        # leaves whose interpolated payloads are fallbacks only -- the
+        # mask routes exactly those through the online fixed-delta QP.
+        semi_mask = export.semi_explicit_mask(res.tree, table)
         cmp = simulator.compare(problem, table, oracle, theta0,
                                 T=args.simulate,
-                                semi_explicit=cfg.algorithm == "feasible")
+                                semi_explicit=cfg.algorithm == "feasible",
+                                semi_mask=semi_mask)
         sim_stats = {
             "theta0": np.asarray(theta0).tolist(),
             "explicit_cost": cmp.explicit.total_cost,

@@ -40,6 +40,7 @@ import jax
 import jax.numpy as jnp
 
 from explicit_hybrid_mpc_tpu.problems import base
+from explicit_hybrid_mpc_tpu.oracle import ipm
 from explicit_hybrid_mpc_tpu.oracle import oracle as omod
 from explicit_hybrid_mpc_tpu.oracle.oracle import (Oracle, VertexSolution,
                                                    to_device)
@@ -208,6 +209,14 @@ class PrunedOracle(Oracle):
             lambda M, d: omod._solve_simplex_min_one(
                 red_dev, M, d, self.n_iter, self.n_f32),
             in_axes=(0, 0)))
+        # Reduced phase-1, the gate behind _stalled_need_resolve: full
+        # schedule for the same reason as the base _point_feas (phase-1
+        # returns no convergence flag, so a schedule miss has no rescue
+        # signal and errs in the unsound direction).
+        self._point_feas_red = jax.jit(
+            jax.vmap(lambda th, d: ipm.phase1(
+                red_dev.G[d], red_dev.w[d] + red_dev.S[d] @ th,
+                n_iter=self.n_iter, n_f32=self.n_f32), in_axes=(0, 0)))
 
     # -- helpers -----------------------------------------------------------
 
@@ -271,15 +280,49 @@ class PrunedOracle(Oracle):
         P, nd = parts[0].shape
         all_d = np.broadcast_to(np.arange(nd)[None, :], (P, nd))
         parts[5] = self._scatter_z(parts[5], all_d)    # z -> full width
-        self._verify_or_fallback(thetas, parts)
+        n_fb, n_gate = self._verify_or_fallback(thetas, parts)
         self._rescue_grid(thetas, parts)
-        self.n_solves += P * nd
-        self.n_point_solves += P * nd
+        # Counters last (base wait_vertices contract): if the transfer,
+        # verification, or rescue raised, the frontier reroutes the WHOLE
+        # batch to the CPU fallback and folds in its own counts --
+        # incrementing before the rescue pass would double-count.
+        self.n_prune_fallbacks += n_fb
+        self.n_solves += P * nd + n_fb + n_gate
+        self.n_point_solves += P * nd + n_fb
         return VertexSolution(*self._finalize(parts))
 
-    def _verify_or_fallback(self, thetas: np.ndarray, parts: list) -> None:
+    def _stalled_need_resolve(self, thetas: np.ndarray, ds: np.ndarray
+                              ) -> np.ndarray:
+        """(K,) bool for stalled (~feasible & ~converged) reduced cells:
+        True = the cell needs a full-problem re-solve.
+
+        Dropping rows relaxes the constraint set, and kept rows touch no
+        dropped variable, so reduced-INFEASIBLE implies full-infeasible;
+        but a stalled reduced solve proves nothing by itself -- a
+        reduced-path stall (different Schur conditioning) on a cell the
+        full path solves would silently flip it to V=inf and break tree
+        parity with an unpruned build.  The gate runs the reduced
+        phase-1 (a strictly feasible QP -- it does not stall): a
+        decisively positive minimal violation certifies the cell
+        infeasible with no re-solve; anything near-feasible (<= 1e-3,
+        loose on purpose: the unsound direction is claiming infeasible)
+        re-solves on the full problem."""
+        K = thetas.shape[0]
+        need = np.empty(K, dtype=bool)
+        cap = self.max_pairs_per_call
+        for lo in range(0, K, cap):
+            tj, dj, Kc = self._pad_pairs(thetas[lo:lo + cap],
+                                         ds[lo:lo + cap].astype(np.int64))
+            t = np.asarray(self._point_feas_red(tj, dj))[:Kc]
+            need[lo:lo + Kc] = ~(np.isfinite(t) & (t > 1e-3))
+        return need
+
+    def _verify_or_fallback(self, thetas: np.ndarray,
+                            parts: list) -> tuple[int, int]:
         """Check every converged reduced grid cell against its dropped
-        rows; re-solve violators on the full problem, in place."""
+        rows; re-solve violators on the full problem, in place.  Returns
+        (fallback re-solve count, phase-1 gate solve count) for the
+        caller to fold into the counters AFTER the rescue pass."""
         V, conv, feas, grad, u0, z = parts[:6]
         P, nd = V.shape
         th_grid = np.broadcast_to(thetas[:, None, :], (P, nd,
@@ -290,17 +333,22 @@ class PrunedOracle(Oracle):
         # ones both re-solve on the full problem: a reduced program can
         # stall where the full one converges (different Schur
         # conditioning), and leaving such a cell at V=inf would flip
-        # dstar vs an unpruned build.  Cells infeasible on the reduced
-        # rows are infeasible on the full set too (kept rows are a
-        # subset and dropped vars touch no kept row) -- no re-solve.
+        # dstar vs an unpruned build.  Cells reporting infeasible-and-
+        # unconverged go through the reduced phase-1 gate
+        # (_stalled_need_resolve): certified-infeasible cells stay, the
+        # rest re-solve full.
         conv_b, feas_b = conv.astype(bool), feas.astype(bool)
         bad = (conv_b & (viol > 1e-6)) | (feas_b & ~conv_b)
+        n_gate = 0
+        stalled = ~feas_b & ~conv_b
+        if np.any(stalled):
+            ps, dss = np.nonzero(stalled)
+            n_gate = ps.size
+            res = self._stalled_need_resolve(thetas[ps], dss)
+            bad[ps[res], dss[res]] = True
         if not np.any(bad):
-            return
+            return 0, n_gate
         pt, ds = np.nonzero(bad)
-        self.n_prune_fallbacks += pt.size
-        self.n_solves += pt.size
-        self.n_point_solves += pt.size
         cap = self.max_pairs_per_call
         for lo in range(0, pt.size, cap):
             tj, dj, Kc = self._pad_pairs(thetas[pt[lo:lo + cap]],
@@ -315,6 +363,7 @@ class PrunedOracle(Oracle):
             j = int(np.argmin(Vm[p]))
             parts[6][p] = Vm[p][j]
             parts[7][p] = j if np.isfinite(Vm[p][j]) else -1
+        return pt.size, n_gate
 
     def _elastic_min_into(self, Ms: np.ndarray, ds: np.ndarray,
                           idx: np.ndarray, out: np.ndarray,
@@ -402,14 +451,22 @@ class PrunedOracle(Oracle):
         conv, feas = conv.astype(bool), feas.astype(bool)
         z = self._scatter_z(z, delta_idx)
         viol = self._dropped_violation(thetas, delta_idx, z)
-        # Same rule as _verify_or_fallback: violators AND feasible-but-
-        # unconverged cells re-solve full (reduced-infeasible is exact).
+        # Same rules as _verify_or_fallback: violators and feasible-but-
+        # unconverged cells re-solve full; stalled cells go through the
+        # reduced phase-1 gate before being trusted as infeasible.
         bad = (conv & (viol > 1e-6)) | (feas & ~conv)
+        n_gate = 0
+        stalled = ~feas & ~conv
+        if np.any(stalled):
+            sidx = np.nonzero(stalled)[0]
+            n_gate = sidx.size
+            res = self._stalled_need_resolve(thetas[sidx],
+                                             delta_idx[sidx])
+            bad[sidx[res]] = True
+        n_fb = 0
         if np.any(bad):
             idx = np.nonzero(bad)[0]
-            self.n_prune_fallbacks += idx.size
-            self.n_solves += idx.size
-            self.n_point_solves += idx.size
+            n_fb = idx.size
             cap = self.max_pairs_per_call
             for lo in range(0, idx.size, cap):
                 sub = idx[lo:lo + cap]
@@ -424,6 +481,8 @@ class PrunedOracle(Oracle):
                 thetas[ridx], delta_idx[ridx])
             V[ridx], conv[ridx], grad[ridx] = rV, rconv, rgrad
             u0[ridx], z[ridx] = ru0, rz
-        self.n_solves += thetas.shape[0]
-        self.n_point_solves += thetas.shape[0]
+        # Counters last (base wait_pairs contract; see wait_vertices).
+        self.n_prune_fallbacks += n_fb
+        self.n_solves += thetas.shape[0] + n_fb + n_gate
+        self.n_point_solves += thetas.shape[0] + n_fb
         return np.where(conv, V, _INF), conv, grad, u0, z

@@ -882,18 +882,35 @@ class FrontierEngine:
         return eng
 
 
+def make_oracle(problem, cfg: PartitionConfig, mesh=None,
+                strict: bool = False) -> Oracle:
+    """The oracle choice, shared by build_partition and the CLI: honors
+    cfg.backend / precision / IPM schedules, and routes through
+    PrunedOracle when cfg.prune_rows is set.  Pruning covers batched
+    single-device backends only; strict=True raises where it cannot take
+    effect (the CLI surfaces the error), strict=False silently builds
+    the plain oracle (the library default)."""
+    kw = dict(backend=cfg.backend, mesh=mesh, precision=cfg.precision,
+              point_schedule=getattr(cfg, "ipm_point_schedule", None),
+              rescue_iter=getattr(cfg, "ipm_rescue_iters", 0))
+    if getattr(cfg, "prune_rows", False):
+        if cfg.backend == "serial" or mesh is not None:
+            if strict:
+                raise ValueError(
+                    "--prune-rows cannot take effect with --mesh or "
+                    "--backend serial (pruning covers batched "
+                    "single-device backends only)")
+        else:
+            from explicit_hybrid_mpc_tpu.oracle.prune import PrunedOracle
+
+            return PrunedOracle(problem, **kw)
+    return Oracle(problem, **kw)
+
+
 def build_partition(problem, cfg: PartitionConfig,
                     oracle: Oracle | None = None) -> PartitionResult:
     """One-call offline build: problem + config -> certified partition."""
     if oracle is None:
-        kw = dict(backend=cfg.backend, precision=cfg.precision,
-                  point_schedule=getattr(cfg, "ipm_point_schedule", None),
-                  rescue_iter=getattr(cfg, "ipm_rescue_iters", 0))
-        if getattr(cfg, "prune_rows", False) and cfg.backend != "serial":
-            from explicit_hybrid_mpc_tpu.oracle.prune import PrunedOracle
-
-            oracle = PrunedOracle(problem, **kw)
-        else:
-            oracle = Oracle(problem, **kw)
+        oracle = make_oracle(problem, cfg)
     log = RunLog(cfg.log_path, echo=False)
     return FrontierEngine(problem, oracle, cfg, log).run()

@@ -239,15 +239,23 @@ def compare(problem, table: LeafTable, oracle: Oracle, theta0: np.ndarray,
             T: int, backend: str = "jax",
             noise: np.ndarray | None = None,
             interpret: bool | None = None,
-            semi_explicit: bool = False) -> Comparison:
+            semi_explicit: bool = False,
+            semi_mask: np.ndarray | None = None) -> Comparison:
     """Same initial condition and noise under both controllers.
 
     semi_explicit=True deploys the feasibility-only variant's intended
     online stage (leaf-fixed delta + small online QP) instead of the
-    interpolated PWA law."""
+    interpolated PWA law.  semi_mask deploys a HYBRID partition: only the
+    marked boundary leaves take the online QP (their interpolated
+    payloads are fallbacks, not certified laws); pass
+    online.export.semi_explicit_mask(tree, table)."""
     if semi_explicit:
         ctrl = SemiExplicitController(table, oracle, backend=backend,
                                       interpret=interpret)
+    elif semi_mask is not None and np.any(semi_mask):
+        ctrl = SemiExplicitController(table, oracle, backend=backend,
+                                      interpret=interpret,
+                                      semi_mask=np.asarray(semi_mask))
     else:
         ctrl = ExplicitController(table, backend=backend,
                                   interpret=interpret)

@@ -136,3 +136,61 @@ def test_bad_problem_arg():
     with pytest.raises(SystemExit):
         main(["-e", "double_integrator", "-a", "0.1", "--backend", "cpu",
               "--problem-arg", "oops"])
+
+
+def test_prune_rows_flag_takes_effect(tmp_path, monkeypatch):
+    """ADVICE r4 (medium): --prune-rows was a silent no-op -- main()
+    built a plain Oracle and never reached build_partition's PrunedOracle
+    branch.  The CLI must construct PrunedOracle, and must error out when
+    the flag cannot take effect (serial / mesh backends)."""
+    from explicit_hybrid_mpc_tpu.oracle import prune as prune_mod
+
+    made = []
+    real = prune_mod.PrunedOracle
+
+    class Spy(real):
+        def __init__(self, *a, **kw):
+            made.append(1)
+            super().__init__(*a, **kw)
+
+    monkeypatch.setattr(prune_mod, "PrunedOracle", Spy)
+    prefix = str(tmp_path / "pr")
+    rc = main(["-e", "double_integrator", "-a", "0.2", "--backend", "cpu",
+               "--batch", "32", "-o", prefix, "--prune-rows",
+               "--problem-arg", "N=3", "--problem-arg", "theta_box=1.5"])
+    assert rc == 0 and made, "--prune-rows did not construct PrunedOracle"
+    with pytest.raises(SystemExit, match="prune-rows"):
+        main(["-e", "double_integrator", "--backend", "serial",
+              "--prune-rows", "-o", str(tmp_path / "x"),
+              "--problem-arg", "N=3"])
+
+
+def test_hybrid_simulate_routes_boundary_leaves(tmp_path, monkeypatch):
+    """ADVICE r4 (medium): --simulate on a hybrid --boundary-depth build
+    deployed the pure ExplicitController, interpolating boundary leaves'
+    fabricated payloads.  main() must hand the semi-explicit mask to the
+    simulator so exactly those leaves take the online fixed-delta QP."""
+    from explicit_hybrid_mpc_tpu.sim import simulator as sim_mod
+
+    seen = {}
+    real = sim_mod.SemiExplicitController
+
+    class Spy(real):
+        def __init__(self, *a, **kw):
+            seen["semi_mask"] = kw.get("semi_mask")
+            super().__init__(*a, **kw)
+
+    monkeypatch.setattr(sim_mod, "SemiExplicitController", Spy)
+    prefix = str(tmp_path / "hy")
+    rc = main(["-e", "mass_spring", "-a", "1.0", "-r", "0.5",
+               "--backend", "cpu", "--batch", "128", "--max-depth", "12",
+               "--boundary-depth", "8", "-o", prefix, "--simulate", "5",
+               "--problem-arg", "N=4", "--problem-arg", "theta_box=3.0"])
+    assert rc == 0
+    stats = json.load(open(f"{prefix}.stats.json"))
+    assert stats["semi_explicit"] > 0, "build produced no boundary leaves"
+    mask = seen.get("semi_mask")
+    assert mask is not None and mask.any(), (
+        "simulate did not deploy SemiExplicitController with the "
+        "boundary-leaf mask")
+    assert not mask.all()  # hybrid: certified interior stays explicit

@@ -106,3 +106,38 @@ def test_partition_parity_with_pruning():
 def test_serial_backend_rejected(quad):
     with pytest.raises(ValueError, match="batched single-device"):
         PrunedOracle(quad, backend="serial")
+
+
+def test_stalled_gate_directions():
+    """ADVICE r4 (low): stalled (~feas & ~conv) reduced cells must not be
+    trusted as infeasible-on-full unchecked.  The reduced phase-1 gate
+    (_stalled_need_resolve) must always demand a re-solve for cells that
+    are actually feasible (the sound direction), while certifying
+    decisively infeasible cells without a full re-solve (the win).
+
+    margin=1e9 keeps every row, making reduced == full: the gate is only
+    ever invoked on cells that stalled on the REDUCED problem, so the
+    certify-infeasible direction needs the infeasibility-carrying rows
+    present in the reduced set (a default-margin oracle may DROP exactly
+    those rows -- such cells then converge reduced-feasible and are
+    caught by the dropped-row violation check instead)."""
+    ms = make("mass_spring", N=4, theta_box=3.0)
+    po = PrunedOracle(ms, backend="cpu", margin=1e9)
+    rng = np.random.default_rng(7)
+    # Interior points are feasible; near-corner points violate the
+    # input-constrained horizon QP decisively (test_boundary's box).
+    inner = rng.uniform(-0.5, 0.5, size=(12, ms.n_theta))
+    sgn = rng.choice([-1.0, 1.0], size=(16, ms.n_theta))
+    corners = sgn * rng.uniform(2.7, 3.0, size=(16, ms.n_theta))
+    full = Oracle(ms, backend="cpu")
+    sol_in = full.solve_vertices(inner)
+    sol_co = full.solve_vertices(corners)
+    ok = (sol_in.conv & sol_in.feas)[:, 0]
+    bad = (~sol_co.feas & ~sol_co.conv)[:, 0]
+    assert ok.any() and bad.any(), "box must straddle feasibility"
+    d0 = np.zeros(int(ok.sum()), dtype=np.int64)
+    need = po._stalled_need_resolve(inner[ok], d0)
+    assert need.all(), "gate certified a FEASIBLE cell infeasible"
+    d0 = np.zeros(int(bad.sum()), dtype=np.int64)
+    need_i = po._stalled_need_resolve(corners[bad], d0)
+    assert not need_i.all(), "gate never certifies -- pruning win erased"

@@ -39,10 +39,13 @@ All progress goes to stderr.
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
+import platform as host_platform
 import subprocess
 import sys
+import threading
 import time
 import traceback
 
@@ -57,6 +60,121 @@ T_START = time.time()
 CACHE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                          ".jax_cache")
 CACHE_MIN_COMPILE_S = "2"
+
+# The capture-active sentinel (owned by scripts/tpu_watch.py during
+# captures): scripts/long_build.py pauses its build loop while this file
+# exists and its mtime keeps advancing.  bench.py holds it too -- the
+# driver runs bench DIRECTLY (not through the watcher), and in round 4 a
+# background campaign on the one-core host silently halved the
+# driver-visible number (259 vs 505 r/s on the same engine).
+SENTINEL = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "artifacts", ".capture_active")
+
+
+def host_cpu_fingerprint() -> str:
+    """Short stable hash of this host's CPU model + feature flags.
+
+    XLA:CPU executables are compiled for the build host's feature set;
+    the persistent cache reuses them across heterogeneous hosts, which
+    XLA itself flags as a SIGILL risk ("Machine type used for XLA:CPU
+    compilation doesn't match the machine type for execution", seen on
+    every r4 long-campaign start).  Keying the CPU cache directory by
+    this fingerprint makes cross-host reuse structurally impossible;
+    accelerator executables are host-independent and keep the shared
+    directory."""
+    txt = host_platform.machine()
+    try:
+        with open("/proc/cpuinfo") as f:
+            for ln in f:
+                if ln.startswith(("model name", "flags", "Features")):
+                    txt += ln
+                    if ln.startswith(("flags", "Features")):
+                        break  # identical across cores
+    except OSError:
+        pass
+    return hashlib.sha1(txt.encode()).hexdigest()[:12]
+
+
+def cpu_cache_dir(base: str | None = None) -> str:
+    """Host-fingerprinted persistent-cache directory for the CPU backend
+    (shared by choose_backend and tests/conftest.py)."""
+    return os.path.join(base or CACHE_DIR,
+                        "cpu-" + host_cpu_fingerprint())
+
+
+class ContentionMonitor:
+    """Background sampler of how much CPU OTHER processes burned while
+    the benchmark ran (r4 weak #1: a competing campaign on the one-core
+    host halved the driver-visible number and nothing recorded it).
+
+    Samples /proc/stat total busy jiffies against /proc/self/stat own
+    (+reaped children) jiffies; the difference over elapsed capacity is
+    the competing share.  summary() feeds the load fields of the bench
+    JSON, and a mean share above `threshold` marks the capture
+    CONTENDED in its own metric line."""
+
+    def __init__(self, interval_s: float = 2.0, threshold: float = 0.05):
+        self.interval_s = interval_s
+        self.threshold = threshold
+        self._stop = threading.Event()
+        self._samples: list[float] = []
+        self._thread: threading.Thread | None = None
+        self._load_start = None
+
+    @staticmethod
+    def _jiffies() -> tuple[int, int] | None:
+        try:
+            with open("/proc/stat") as f:
+                vals = [int(x) for x in f.readline().split()[1:]]
+            busy = sum(vals) - vals[3] - (vals[4] if len(vals) > 4 else 0)
+            with open("/proc/self/stat") as f:
+                st = f.read().rsplit(")", 1)[1].split()
+            own = sum(int(x) for x in st[11:15])  # utime stime cu cs
+            return busy, own
+        except (OSError, IndexError, ValueError):
+            return None  # non-procfs host: monitor degrades to loadavg
+
+    def _run(self) -> None:
+        hz = os.sysconf("SC_CLK_TCK")
+        ncpu = os.cpu_count() or 1
+        prev, prev_t = self._jiffies(), time.time()
+        while not self._stop.wait(self.interval_s):
+            cur, now = self._jiffies(), time.time()
+            if prev is not None and cur is not None:
+                cap = (now - prev_t) * hz * ncpu
+                if cap > 0:
+                    other = (cur[0] - prev[0]) - (cur[1] - prev[1])
+                    self._samples.append(min(1.0, max(0.0, other / cap)))
+            prev, prev_t = cur, now
+
+    def start(self) -> "ContentionMonitor":
+        try:
+            self._load_start = os.getloadavg()
+        except OSError:
+            pass
+        if self._jiffies() is not None:
+            self._thread = threading.Thread(target=self._run, daemon=True)
+            self._thread.start()
+        return self
+
+    def summary(self) -> dict:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2 * self.interval_s)
+        out = {"cpu_count": os.cpu_count()}
+        try:
+            out["loadavg_end"] = [round(x, 2) for x in os.getloadavg()]
+        except OSError:
+            pass
+        if self._load_start is not None:
+            out["loadavg_start"] = [round(x, 2) for x in self._load_start]
+        if self._samples:
+            mean = float(np.mean(self._samples))
+            out.update(
+                competing_cpu_frac_mean=round(mean, 3),
+                competing_cpu_frac_max=round(max(self._samples), 3),
+                contended=mean > self.threshold)
+        return out
 
 
 def log(msg: str) -> None:
@@ -124,6 +242,13 @@ def choose_backend(result: dict | None = None) -> str:
     # under load), so a bench run that can reload the watcher's compiles
     # spends its deadline measuring instead of compiling.
     cache_dir = os.environ.get("JAX_COMPILATION_CACHE_DIR", CACHE_DIR)
+    if (chosen == "cpu" and os.path.basename(cache_dir)
+            != "cpu-" + host_cpu_fingerprint()):
+        # XLA:CPU executables are host-feature-specific; key the CPU
+        # cache by the host fingerprint so a cache written on another
+        # machine type can never be loaded here (r4 weak #8: SIGILL-risk
+        # warnings on every long-campaign start).
+        cache_dir = cpu_cache_dir(cache_dir)
     try:
         jax.config.update("jax_compilation_cache_dir", cache_dir)
         jax.config.update(
@@ -320,10 +445,16 @@ def warm_oracle(oracle, problem, stop_after: float | None = None) -> None:
         b *= 2
 
 
-def run(result: dict) -> None:
+def run(result: dict, monitor: ContentionMonitor | None = None) -> None:
     """The benchmark body; fills `result` incrementally so a late failure
     still ships every field gathered so far."""
     platform = choose_backend(result)
+    if monitor is not None:
+        # Started only AFTER the backend probe: the probe's throwaway
+        # subprocess burns the core for seconds and its jiffies reach
+        # /proc/self/stat only at reap, so sampling across it would
+        # mis-attribute bench's own work as competing load.
+        monitor.start()
     on_acc = platform != "cpu"
 
     import jax
@@ -542,15 +673,79 @@ def run(result: dict) -> None:
         log(f"online metric skipped: {e!r}")
 
 
+def hold_sentinel():
+    """Create (if absent) and heartbeat the capture-active sentinel so a
+    concurrent scripts/long_build.py pauses for the duration of this
+    bench run; returns a stop() callable.
+
+    Ownership is decided ATOMICALLY (O_CREAT|O_EXCL): a plain
+    exists-then-open check could race the watcher's own capture start
+    and later unlink ITS live sentinel.  When the watcher owned the file
+    first and removes it mid-bench (its capture -- this very bench run,
+    usually -- finished), the beat thread re-creates it so the rest of
+    the run stays protected; stop() then unlinks the re-created file.
+    The 20-s beat window leaves one benign race: the watcher starting a
+    NEW capture in the same instant loses its sentinel to our stop() and
+    re-asserts it at its next heartbeat."""
+    state = {"owned": False}
+    try:
+        os.makedirs(os.path.dirname(SENTINEL), exist_ok=True)
+        try:
+            os.close(os.open(SENTINEL, os.O_CREAT | os.O_EXCL | os.O_WRONLY))
+            state["owned"] = True
+        except FileExistsError:
+            pass  # the watcher holds it; we only heartbeat
+    except OSError:
+        return lambda: None
+    stop_ev = threading.Event()
+
+    def beat():
+        while not stop_ev.wait(20.0):
+            try:
+                if not os.path.exists(SENTINEL):
+                    open(SENTINEL, "a").close()
+                    state["owned"] = True  # original owner released it
+                os.utime(SENTINEL)
+            except OSError:
+                pass
+
+    threading.Thread(target=beat, daemon=True).start()
+
+    def stop():
+        stop_ev.set()
+        if state["owned"]:
+            try:
+                os.unlink(SENTINEL)
+            except OSError:
+                pass
+
+    return stop
+
+
 def main() -> int:
     result: dict = {"metric": "offline regions/sec", "value": None,
                     "unit": "regions/s", "vs_baseline": None}
+    release = hold_sentinel()
+    monitor = ContentionMonitor()
     try:
-        run(result)
+        run(result, monitor)
     except BaseException as e:
         result["error"] = repr(e)
         traceback.print_exc(file=sys.stderr)
     finally:
+        host = monitor.summary()
+        result["host"] = host
+        if host.get("contended"):
+            # The contention verdict rides the metric line itself so a
+            # contended capture can never read as a clean number.
+            result["metric"] = (
+                result.get("metric", "") +
+                f" [CONTENDED: competing processes used "
+                f"{100 * host['competing_cpu_frac_mean']:.0f}% of CPU]")
+            log(f"WARNING: contended capture -- competing CPU share "
+                f"mean {host['competing_cpu_frac_mean']:.1%}, "
+                f"max {host.get('competing_cpu_frac_max', 0):.1%}")
+        release()
         # The one guaranteed JSON line, success or not.
         print(json.dumps(result), flush=True)
         out_path = os.environ.get("BENCH_OUT")

@@ -21,19 +21,27 @@ if "host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
 
-# Persistent compile cache dir (harmless no-op on the CPU backend in
-# this jax build -- it only writes for accelerator backends; the env var
-# mainly reaches the capture-script smoke tests' subprocesses so a
-# chip-up capture session shares warm compiles).
-os.environ.setdefault(
-    "JAX_COMPILATION_CACHE_DIR",
-    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-                 ".jax_cache"))
+# Persistent compile cache.  The ENV VAR stays the shared base dir --
+# capture-script subprocesses inherit it and bench.choose_backend
+# re-keys it per backend (TPU children must keep sharing the watcher's
+# warm tunnel compiles).  These in-process tests are forced-CPU, and
+# XLA:CPU executables are host-feature-specific (cross-host reuse is a
+# SIGILL risk XLA warns about), so the IN-PROCESS jax config points at
+# the bench.cpu_cache_dir() fingerprinted subdirectory instead.
+import sys as _sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in _sys.path:
+    _sys.path.insert(0, _REPO)
+from bench import CACHE_DIR, cpu_cache_dir  # noqa: E402
+
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", CACHE_DIR)
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "2")
 
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_compilation_cache_dir", cpu_cache_dir())
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
@@ -56,6 +64,8 @@ _SLOW = (
     "test_post.py::",
     "test_sim.py::",
     "test_bench.py::test_bench_smoke_cpu_emits_json",
+    "test_bench.py::test_bench_smoke_carries_host_fields",
+    "test_bench.py::test_contention_monitor_sees_competing_load",
     "test_bnb.py::test_root_bounds_are_lower_bounds",
     "test_bnb.py::test_bnb_matches_enumeration",
     "test_bnb.py::test_pruning_happens",

@@ -114,6 +114,14 @@ class PartitionConfig:
     # programs only; exact by construction.  Big win on row-heavy
     # configs (quadrotor: 360 -> ~100 rows); off by default.
     prune_rows: bool = False
+    # Store the (p+1, nz) full primal sequences per converged leaf
+    # (LeafData.vertex_z).  They feed the offline sampled-soundness
+    # checks (scripts/precision_check.py) and full-sequence
+    # interpolation, NOT the deployed first-move controller; at
+    # cluster scale they are the single largest leaf payload (~1 GB per
+    # 0.8M satellite leaves), so multi-million-region campaigns can turn
+    # them off (scripts/long_build.py LONG_STORE_Z=0).
+    store_vertex_z: bool = True
     seed: int = 0
 
     def __post_init__(self) -> None:

@@ -129,6 +129,7 @@ class FrontierEngine:
         # hanging-node midpoint that resurrects an evicted vertex just
         # re-solves -- the cache is a cache, correctness is unaffected).
         self._refcount: collections.Counter[bytes] = collections.Counter()
+        self._node_keys = {}
         for n in self.roots:
             self._retain(n)
         # node -> {delta: lower bound on min_R V_delta} inherited from
@@ -204,19 +205,30 @@ class FrontierEngine:
         finally:
             self._oracle_s += time.perf_counter() - t0
 
+    def _keys(self, node: int) -> list[bytes]:
+        """Cache keys of `node`'s vertices, memoized for the node's open
+        lifetime (each open node's keys are read by _retain, planning,
+        the batch gather, and _release -- recomputing the rounding+
+        tobytes per use dominated host time at cluster scale)."""
+        ks = self._node_keys.get(node)
+        if ks is None:
+            ks = geometry.vertex_keys(self.tree.vertices[node])
+            self._node_keys[node] = ks
+        return ks
+
     def _retain(self, node: int) -> None:
-        for v in self.tree.vertices[node]:
-            self._refcount[geometry.vertex_key(v)] += 1
+        for k in self._keys(node):
+            self._refcount[k] += 1
 
     def _release(self, node: int) -> None:
-        for v in self.tree.vertices[node]:
-            k = geometry.vertex_key(v)
+        for k in self._keys(node):
             c = self._refcount[k] - 1
             if c <= 0:
                 del self._refcount[k]
                 self.cache.evict_key(k)
             else:
                 self._refcount[k] = c
+        self._node_keys.pop(node, None)
 
     # -- vertex solves -----------------------------------------------------
 
@@ -258,8 +270,7 @@ class FrontierEngine:
                 if excl:
                     act = full.copy()
                     act[excl] = False
-            for v in self.tree.vertices[n]:
-                k = geometry.vertex_key(v)
+            for k, v in zip(self._keys(n), self.tree.vertices[n]):
                 cur = need.get(k)
                 if cur is None:
                     need[k] = act
@@ -410,19 +421,46 @@ class FrontierEngine:
             self.oracle.n_rescue_solves += fb.n_rescue_solves - before[3]
             return out
 
-    def _vertex_data(self, node: int) -> certify.SimplexVertexData:
-        verts = self.tree.vertices[node]
-        rows = [self.cache.get(v) for v in verts]
-        return certify.SimplexVertexData(
-            verts=verts,
-            V=np.stack([r[0] for r in rows]),
-            conv=np.stack([r[1] for r in rows]),
-            grad=np.stack([r[2] for r in rows]),
-            u0=np.stack([r[3] for r in rows]),
-            z=np.stack([r[4] for r in rows]),
-            Vstar=np.array([r[5] for r in rows]),
-            dstar=np.array([r[6] for r in rows]),
-        )
+    def _gather_batch(self, nodes: list[int]) -> tuple[dict, tuple]:
+        """Vertex data for the whole batch: ONE cache lookup per unique
+        vertex and one stack per result field, with per-node
+        SimplexVertexData as views into the batch tensors.  (The per-node
+        7-row stacks and duplicate per-(node, vertex) lookups of the old
+        scalar path were, with vertex_key, the top host costs in the
+        cluster-scale step profile.)
+
+        Returns (sds, (verts, V, conv, grad, u0, z, Vstar, dstar)); the
+        tensors have leading dims (B, p+1, ...) and feed
+        certify_stage1_batch directly, so the batch is stacked once, not
+        twice."""
+        rows: list[tuple] = []
+        idx_of: dict[bytes, int] = {}
+        m = self.tree.p + 1
+        node_ix = np.empty((len(nodes), m), dtype=np.int64)
+        for bi, n in enumerate(nodes):
+            for vi, k in enumerate(self._keys(n)):
+                j = idx_of.get(k)
+                if j is None:
+                    row = self.cache.get_key(k)
+                    if row is None:
+                        raise KeyError(f"vertex row missing for node {n}")
+                    j = len(rows)
+                    idx_of[k] = j
+                    rows.append(row)
+                node_ix[bi, vi] = j
+        verts = self.tree.vertices[np.asarray(nodes, dtype=np.int64)]
+        V = np.stack([r[0] for r in rows])[node_ix]
+        conv = np.stack([r[1] for r in rows])[node_ix]
+        grad = np.stack([r[2] for r in rows])[node_ix]
+        u0 = np.stack([r[3] for r in rows])[node_ix]
+        z = np.stack([r[4] for r in rows])[node_ix]
+        Vstar = np.asarray([r[5] for r in rows])[node_ix]
+        dstar = np.asarray([r[6] for r in rows])[node_ix]
+        sds = {n: certify.SimplexVertexData(
+                   verts=verts[bi], V=V[bi], conv=conv[bi], grad=grad[bi],
+                   u0=u0[bi], z=z[bi], Vstar=Vstar[bi], dstar=dstar[bi])
+               for bi, n in enumerate(nodes)}
+        return sds, (verts, V, conv, grad, u0, z, Vstar, dstar)
 
     # -- one frontier step -------------------------------------------------
 
@@ -466,7 +504,6 @@ class FrontierEngine:
 
         results: dict[int, certify.CertificateResult] = {}
         stage2: list[tuple[int, int]] = []  # (node, delta')
-        sds: dict[int, certify.SimplexVertexData] = {}
         infeas_candidates: list[int] = []
         use_inh = getattr(self.cfg, "inherit_bounds", True)
         bary_memo: dict[int, np.ndarray] = {}
@@ -481,22 +518,18 @@ class FrontierEngine:
         # exclusions, certified simplex lower bounds) -- inherited by children when
         # the node splits.
         fresh: dict[int, dict[int, float]] = collections.defaultdict(dict)
-        for n in nodes:
-            sds[n] = self._vertex_data(n)
+        sds, (bverts, bV, bconv, bgrad, _bu0, _bz, bVstar, bdstar) = \
+            self._gather_batch(nodes)
         if self.cfg.algorithm == "feasible":
             for n in nodes:
                 results[n] = certify.certify_feasible(sds[n])
         else:
             # Batched stage-1 certification: one vectorized pass over the
             # whole batch (decision-identical to the scalar path; the
-            # per-node tangent einsums dominated host time).
+            # per-node tangent einsums dominated host time), fed the batch
+            # tensors the gather already built.
             res_list = certify.certify_stage1_batch(
-                np.stack([sds[n].verts for n in nodes]),
-                np.stack([sds[n].V for n in nodes]),
-                np.stack([sds[n].conv for n in nodes]),
-                np.stack([sds[n].grad for n in nodes]),
-                np.stack([sds[n].Vstar for n in nodes]),
-                np.stack([sds[n].dstar for n in nodes]),
+                bverts, bV, bconv, bgrad, bVstar, bdstar,
                 self.cfg.eps_a, self.cfg.eps_r)
             for n, res in zip(nodes, res_list):
                 if res.status == "certified":
@@ -609,6 +642,7 @@ class FrontierEngine:
                         self.cfg.eps_r)
 
         n_leaves = n_splits = 0
+        store_z = getattr(self.cfg, "store_vertex_z", True)
         for n in nodes:
             res = results[n]
             if res.status == "certified":
@@ -616,7 +650,7 @@ class FrontierEngine:
                     delta_idx=res.delta_idx,
                     vertex_inputs=res.vertex_inputs,
                     vertex_costs=res.vertex_costs,
-                    vertex_z=res.vertex_z))
+                    vertex_z=res.vertex_z if store_z else None))
                 n_leaves += 1
             elif res.status == "infeasible":
                 pass  # leaf with no data: outside the feasible region
@@ -640,8 +674,8 @@ class FrontierEngine:
                         u, V, z = certify.boundary_payload(sd, d)
                         self.tree.set_leaf(n, LeafData(
                             delta_idx=d, vertex_inputs=u, vertex_costs=V,
-                            vertex_z=z, certified=False,
-                            semi_explicit=True))
+                            vertex_z=z if store_z else None,
+                            certified=False, semi_explicit=True))
                         self.n_semi_explicit += 1
                         n_leaves += 1
                         self._inherit.pop(n, None)
@@ -657,7 +691,8 @@ class FrontierEngine:
                         self.tree.set_leaf(n, LeafData(
                             delta_idx=d, vertex_inputs=sd.u0[:, d, :],
                             vertex_costs=sd.V[:, d],
-                            vertex_z=sd.z[:, d, :], certified=False))
+                            vertex_z=(sd.z[:, d, :] if store_z else None),
+                            certified=False))
                     self._inherit.pop(n, None)
                     self._release(n)
                     continue
@@ -874,6 +909,9 @@ class FrontierEngine:
         # drop cache rows no open simplex references (the snapshot may
         # predate their eviction).
         eng._refcount = collections.Counter()
+        # node -> vertex cache keys memo (see _keys): populated here for
+        # the restored open set, dropped per node in _release.
+        eng._node_keys = {}
         for n in eng.frontier:
             eng._retain(n)
         for k in list(eng.cache._d):

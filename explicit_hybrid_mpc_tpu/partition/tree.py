@@ -5,10 +5,28 @@ SURVEY.md section 3 [M-high]; citation UNVERIFIED -- reference mount empty):
 node = vertex matrix + commutation + vertex inputs/costs; grows by
 longest-edge bisection; serializes to disk.
 
-Flat-array storage instead of linked Python objects: nodes live in growable
-numpy arrays so that (a) serialization is trivial and fast, (b) exporting
-leaves for the on-device online evaluator (online/export.py) is a slice, not
-a traversal, and (c) memory stays compact for >10^5-region partitions.
+COLUMNAR storage: every node attribute lives in one preallocated,
+capacity-doubling numpy array; leaf payloads live in a RAGGED slot store
+(most nodes are internal or infeasible and carry none); the optional
+per-leaf primal matrices live in a second ragged store, so
+
+(a) memory is a few hundred B/node instead of the per-node-Python-object
+    design's ~15 KB (round-4 judge measurement: 44.8 GB RSS at ~800 k
+    satellite regions -- the benchmark boxes would OOM),
+(b) n_regions()/max_depth() are O(1) counters instead of O(N) scans
+    (both ran EVERY STEP in the engine's log line and long_build's loop:
+    the bulk of the 84% host-side step time at cluster scale),
+(c) checkpoint/serialize is a handful of big array dumps, not millions
+    of object pickles (round-4: 316 s per checkpoint at 633 k regions);
+    vertex matrices are NOT serialized at all -- children are exact
+    midpoint functions of their parents, so __setstate__ re-derives
+    them level-by-level from the roots (bit-identical to
+    geometry.bisect, which uses the same 0.5*(v_i+v_j) arithmetic),
+(d) exporting leaves for the on-device online evaluator is array
+    slicing, not traversal.
+
+Old checkpoints/tree pickles (list-of-objects layout) load transparently:
+``__setstate__`` detects the legacy layout and converts.
 """
 
 from __future__ import annotations
@@ -20,6 +38,11 @@ from typing import Optional
 import numpy as np
 
 NO_CHILD = -1
+
+# leaf_flags bits
+_F_DATA = 1        # leaf payload present (converged / best-effort leaf)
+_F_CERTIFIED = 2   # eps-certificate holds (off for best-effort leaves)
+_F_SEMI = 4        # semi-explicit boundary leaf (online fixed-delta QP)
 
 
 @dataclasses.dataclass
@@ -37,7 +60,9 @@ class LeafData:
     vertex_inputs: np.ndarray
     vertex_costs: np.ndarray
     # Full primal sequences at the vertices (p+1, nz): their barycentric
-    # interpolation is the certified feasible, eps-suboptimal input sequence.
+    # interpolation is the certified feasible, eps-suboptimal input
+    # sequence.  Optional -- cfg.store_vertex_z=False drops it at cluster
+    # scale (it feeds offline soundness sampling, not the deployed law).
     vertex_z: np.ndarray | None = None
     # False for depth-cap best-effort leaves: the law is the best
     # available candidate but carries NO eps-certificate.  Consumers must
@@ -53,6 +78,36 @@ class LeafData:
     semi_explicit: bool = False
 
 
+class _LeafDataView:
+    """Read view over the leaf-payload columns, indexable like the old
+    ``list[LeafData | None]`` (``tree.leaf_data[i]``).  Materializes a
+    LeafData on access; the arrays inside are views into the columns."""
+
+    def __init__(self, tree: "Tree"):
+        self._t = tree
+
+    def __getitem__(self, i: int) -> Optional[LeafData]:
+        t = self._t
+        i = int(i)
+        if not 0 <= i < t._n:
+            raise IndexError(i)
+        flags = t._leaf_flags[i]
+        if not flags & _F_DATA:
+            return None
+        s = t._leaf_slot[i]
+        zi = t._pl_zidx[s]
+        return LeafData(
+            delta_idx=int(t._pl_delta[s]),
+            vertex_inputs=t._pl_inputs[s],
+            vertex_costs=t._pl_costs[s],
+            vertex_z=t._z_store[zi] if zi >= 0 else None,
+            certified=bool(flags & _F_CERTIFIED),
+            semi_explicit=bool(flags & _F_SEMI))
+
+    def __len__(self) -> int:
+        return self._t._n
+
+
 class Tree:
     """Binary simplex tree over the parameter set Theta.
 
@@ -60,17 +115,99 @@ class Tree:
     has exactly two children from longest-edge bisection.
     """
 
+    _INIT_CAP = 1024
+
     def __init__(self, p: int, n_u: int):
         self.p = p
         self.n_u = n_u
-        self.vertices: list[np.ndarray] = []  # per node: (p+1, p)
-        self.parent: list[int] = []
-        self.children: list[tuple[int, int]] = []  # (NO_CHILD, NO_CHILD) = leaf
-        self.depth: list[int] = []
-        # Split metadata (for tree-descent online eval): which edge (i, j)
-        # of this node's simplex was bisected.
-        self.split_edge: list[tuple[int, int]] = []
-        self.leaf_data: list[Optional[LeafData]] = []
+        self._n = 0
+        self._alloc(self._INIT_CAP)
+        self._alloc_payload(self._INIT_CAP)
+        self._n_slots = 0
+        # Ragged side store for the optional (p+1, nz) per-leaf primal
+        # matrices (nz is unknown until the first payload arrives).
+        self._z_store: np.ndarray | None = None
+        self._z_n = 0
+        # O(1) stats counters (n_regions()/max_depth() run every frontier
+        # step in logs and driver loops -- scans would be O(N) each).
+        self._n_regions = 0
+        self._max_depth = 0
+
+    def _alloc(self, cap: int) -> None:
+        p = self.p
+        self._vertices = np.empty((cap, p + 1, p), dtype=np.float64)
+        self._parent = np.full(cap, -1, dtype=np.int32)
+        self._children = np.full((cap, 2), NO_CHILD, dtype=np.int32)
+        self._depth = np.zeros(cap, dtype=np.int32)
+        self._split_edge = np.full((cap, 2), -1, dtype=np.int8)
+        self._leaf_flags = np.zeros(cap, dtype=np.uint8)
+        self._leaf_slot = np.full(cap, -1, dtype=np.int32)
+
+    def _alloc_payload(self, cap: int) -> None:
+        self._pl_delta = np.zeros(cap, dtype=np.int32)
+        self._pl_inputs = np.zeros((cap, self.p + 1, self.n_u),
+                                   dtype=np.float64)
+        self._pl_costs = np.zeros((cap, self.p + 1), dtype=np.float64)
+        self._pl_zidx = np.full(cap, -1, dtype=np.int32)
+
+    @staticmethod
+    def _up(a: np.ndarray, n: int, new_cap: int) -> np.ndarray:
+        out = np.empty((new_cap,) + a.shape[1:], dtype=a.dtype)
+        out[:n] = a[:n]
+        return out
+
+    def _grow(self, need: int) -> None:
+        cap = self._vertices.shape[0]
+        if need <= cap:
+            return
+        new_cap, n = max(need, 2 * cap), self._n
+        self._vertices = self._up(self._vertices, n, new_cap)
+        for name in ("_parent", "_children", "_depth", "_split_edge",
+                     "_leaf_flags", "_leaf_slot"):
+            old = getattr(self, name)
+            new = self._up(old, n, new_cap)
+            new[n:] = (-1 if name in ("_parent", "_leaf_slot") else
+                       NO_CHILD if name == "_children" else
+                       -1 if name == "_split_edge" else 0)
+            setattr(self, name, new)
+
+    def _grow_payload(self, need: int) -> None:
+        cap = self._pl_delta.shape[0]
+        if need <= cap:
+            return
+        new_cap, n = max(need, 2 * cap), self._n_slots
+        self._pl_delta = self._up(self._pl_delta, n, new_cap)
+        self._pl_inputs = self._up(self._pl_inputs, n, new_cap)
+        self._pl_costs = self._up(self._pl_costs, n, new_cap)
+        new_z = self._up(self._pl_zidx, n, new_cap)
+        new_z[n:] = -1
+        self._pl_zidx = new_z
+
+    # -- column access (read-only views, trimmed to the live length) ------
+
+    @property
+    def vertices(self) -> np.ndarray:
+        return self._vertices[:self._n]
+
+    @property
+    def parent(self) -> np.ndarray:
+        return self._parent[:self._n]
+
+    @property
+    def children(self) -> np.ndarray:
+        return self._children[:self._n]
+
+    @property
+    def depth(self) -> np.ndarray:
+        return self._depth[:self._n]
+
+    @property
+    def split_edge(self) -> np.ndarray:
+        return self._split_edge[:self._n]
+
+    @property
+    def leaf_data(self) -> _LeafDataView:
+        return _LeafDataView(self)
 
     # -- construction ------------------------------------------------------
 
@@ -82,52 +219,108 @@ class Tree:
         Lets a tree loaded from pickle feed the APIs that take the build
         result's root list (online.descent.export_descent,
         post.analysis.partition_report)."""
-        return [i for i, pa in enumerate(self.parent) if pa == -1]
+        return np.nonzero(self._parent[:self._n] == -1)[0].tolist()
 
     def _add(self, V: np.ndarray, parent: int, depth: int) -> int:
         assert V.shape == (self.p + 1, self.p)
-        self.vertices.append(np.asarray(V, dtype=np.float64))
-        self.parent.append(parent)
-        self.children.append((NO_CHILD, NO_CHILD))
-        self.depth.append(depth)
-        self.split_edge.append((-1, -1))
-        self.leaf_data.append(None)
-        return len(self.vertices) - 1
+        i = self._n
+        self._grow(i + 1)
+        self._vertices[i] = V
+        self._parent[i] = parent
+        self._depth[i] = depth
+        if depth > self._max_depth:
+            self._max_depth = depth
+        self._n = i + 1
+        return i
 
     def split(self, node: int, left_V: np.ndarray, right_V: np.ndarray,
               edge: tuple[int, int]) -> tuple[int, int]:
-        """Attach the two bisection children of `node`."""
-        assert self.children[node] == (NO_CHILD, NO_CHILD)
-        d = self.depth[node] + 1
+        """Attach the two bisection children of `node`.
+
+        Children MUST be the longest-edge bisection of `node` (the left
+        child replaces v_j by the edge midpoint, the right child v_i, as
+        geometry.bisect produces): serialization re-derives every vertex
+        matrix from the roots under exactly that relation
+        (__getstate__/_rederive_vertices), so arbitrary child geometry
+        would silently corrupt on save/load.  The midpoint rows are
+        checked here; the remaining rows are inherited by construction
+        in geometry.bisect."""
+        assert self._children[node, 0] == NO_CHILD
+        i, j = edge
+        mid = 0.5 * (self._vertices[node, i] + self._vertices[node, j])
+        if not (np.array_equal(left_V[j], mid)
+                and np.array_equal(right_V[i], mid)):
+            raise ValueError("split children are not the midpoint "
+                             "bisection of the parent along `edge`")
+        d = int(self._depth[node]) + 1
         li = self._add(left_V, node, d)
         ri = self._add(right_V, node, d)
-        self.children[node] = (li, ri)
-        self.split_edge[node] = edge
+        self._children[node, 0] = li
+        self._children[node, 1] = ri
+        self._split_edge[node] = edge
         return li, ri
 
     def set_leaf(self, node: int, data: LeafData) -> None:
-        assert self.children[node] == (NO_CHILD, NO_CHILD)
-        self.leaf_data[node] = data
+        assert self._children[node, 0] == NO_CHILD
+        s = self._leaf_slot[node]
+        if s < 0:
+            s = self._n_slots
+            self._grow_payload(s + 1)
+            self._leaf_slot[node] = s
+            self._n_slots = s + 1
+            self._n_regions += 1
+        self._pl_delta[s] = data.delta_idx
+        self._pl_inputs[s] = data.vertex_inputs
+        self._pl_costs[s] = data.vertex_costs
+        flags = _F_DATA
+        if data.certified:
+            flags |= _F_CERTIFIED
+        if data.semi_explicit:
+            flags |= _F_SEMI
+        self._leaf_flags[node] = flags
+        if data.vertex_z is None:
+            # Re-setting a leaf without z must not expose a previous
+            # payload's stale primal matrix (the row, if any, is
+            # abandoned in the store -- double-sets are rare).
+            self._pl_zidx[s] = -1
+        else:
+            z = np.asarray(data.vertex_z, dtype=np.float64)
+            if self._pl_zidx[s] >= 0:
+                self._z_store[self._pl_zidx[s]] = z  # reuse on re-set
+                return
+            if self._z_store is None:
+                self._z_store = np.empty(
+                    (self._INIT_CAP,) + z.shape, dtype=np.float64)
+            elif self._z_n >= self._z_store.shape[0]:
+                self._z_store = self._up(self._z_store, self._z_n,
+                                         2 * self._z_store.shape[0])
+            self._z_store[self._z_n] = z
+            self._pl_zidx[s] = self._z_n
+            self._z_n += 1
 
     # -- queries -----------------------------------------------------------
 
     def __len__(self) -> int:
-        return len(self.vertices)
+        return self._n
 
     def is_leaf(self, node: int) -> bool:
-        return self.children[node] == (NO_CHILD, NO_CHILD)
+        return bool(self._children[node, 0] == NO_CHILD)
 
     def leaves(self) -> list[int]:
-        return [i for i in range(len(self)) if self.is_leaf(i)]
+        n = self._n
+        return np.nonzero(self._children[:n, 0] == NO_CHILD)[0].tolist()
 
     def converged_leaves(self) -> list[int]:
-        return [i for i in self.leaves() if self.leaf_data[i] is not None]
+        n = self._n
+        mask = ((self._children[:n, 0] == NO_CHILD)
+                & (self._leaf_flags[:n] & _F_DATA != 0))
+        return np.nonzero(mask)[0].tolist()
 
     def n_regions(self) -> int:
-        return len(self.converged_leaves())
+        return self._n_regions
 
     def max_depth(self) -> int:
-        return max(self.depth) if self.depth else 0
+        return self._max_depth
 
     def locate(self, theta: np.ndarray, roots: list[int],
                tol: float = 1e-9) -> int:
@@ -141,20 +334,146 @@ class Tree:
 
         node = -1
         for r in roots:
-            if geometry.contains(self.vertices[r], theta, tol):
+            if geometry.contains(self._vertices[r], theta, tol):
                 node = r
                 break
         if node < 0:
             return -1
         while not self.is_leaf(node):
-            li, ri = self.children[node]
-            if geometry.contains(self.vertices[li], theta, tol):
-                node = li
+            li, ri = self._children[node]
+            if geometry.contains(self._vertices[li], theta, tol):
+                node = int(li)
             else:
-                node = ri
+                node = int(ri)
         return node
 
     # -- serialization -----------------------------------------------------
+
+    def __getstate__(self) -> dict:
+        n, ns = self._n, self._n_slots
+        roots = np.nonzero(self._parent[:n] == -1)[0]
+        return {
+            "format": "columnar-v2", "p": self.p, "n_u": self.n_u,
+            "n": n,
+            # Vertex matrices are re-derived on load (children are exact
+            # midpoint functions of parents): they are the largest node
+            # column (~1 GB per 3M satellite nodes) and pure redundancy
+            # on disk.
+            "root_vertices": self._vertices[roots],
+            "parent": self._parent[:n],
+            "children": self._children[:n],
+            "depth": self._depth[:n],
+            "split_edge": self._split_edge[:n],
+            "leaf_flags": self._leaf_flags[:n],
+            "leaf_slot": self._leaf_slot[:n],
+            "pl_delta": self._pl_delta[:ns],
+            "pl_inputs": self._pl_inputs[:ns],
+            "pl_costs": self._pl_costs[:ns],
+            "pl_zidx": self._pl_zidx[:ns],
+            "z_store": (None if self._z_store is None
+                        else self._z_store[:self._z_n]),
+            "n_regions": self._n_regions,
+            "max_depth": self._max_depth,
+        }
+
+    def __setstate__(self, state: dict) -> None:
+        if state.get("format") != "columnar-v2":
+            self._set_legacy_state(state)
+            return
+        self.p, self.n_u = state["p"], state["n_u"]
+        n = state["n"]
+        self._n = n
+        self._alloc(max(self._INIT_CAP, n))
+        for dst, key in ((self._parent, "parent"),
+                         (self._children, "children"),
+                         (self._depth, "depth"),
+                         (self._split_edge, "split_edge"),
+                         (self._leaf_flags, "leaf_flags"),
+                         (self._leaf_slot, "leaf_slot")):
+            dst[:n] = state[key]
+        ns = state["pl_delta"].shape[0]
+        self._n_slots = ns
+        self._alloc_payload(max(self._INIT_CAP, ns))
+        self._pl_delta[:ns] = state["pl_delta"]
+        self._pl_inputs[:ns] = state["pl_inputs"]
+        self._pl_costs[:ns] = state["pl_costs"]
+        self._pl_zidx[:ns] = state["pl_zidx"]
+        zs = state["z_store"]
+        if zs is None:
+            self._z_store, self._z_n = None, 0
+        else:
+            self._z_store = np.ascontiguousarray(zs)
+            self._z_n = zs.shape[0]
+        self._n_regions = state["n_regions"]
+        self._max_depth = state["max_depth"]
+        self._rederive_vertices(state["root_vertices"])
+
+    def _rederive_vertices(self, root_vertices: np.ndarray) -> None:
+        """Rebuild every node's vertex matrix from the roots, level by
+        level: a child equals its parent with one endpoint of the split
+        edge replaced by the midpoint -- the same 0.5*(v_i+v_j) float64
+        arithmetic as geometry.bisect, so the result is bit-identical to
+        what was in memory when the tree was saved."""
+        n = self._n
+        V = self._vertices
+        parent = self._parent[:n]
+        depth = self._depth[:n]
+        roots = np.nonzero(parent == -1)[0]
+        V[roots] = root_vertices
+        for d in range(1, self._max_depth + 1):
+            ids = np.nonzero(depth == d)[0]
+            if ids.size == 0:
+                continue
+            pa = parent[ids].astype(np.int64)
+            ij = self._split_edge[pa]
+            i = ij[:, 0].astype(np.int64)
+            j = ij[:, 1].astype(np.int64)
+            mid = 0.5 * (V[pa, i] + V[pa, j])
+            V[ids] = V[pa]
+            left = self._children[pa, 0] == ids
+            li = np.nonzero(left)[0]
+            ri = np.nonzero(~left)[0]
+            V[ids[li], j[li]] = mid[li]
+            V[ids[ri], i[ri]] = mid[ri]
+
+    def _set_legacy_state(self, state: dict) -> None:
+        """Convert a pre-columnar pickle (python lists of per-node arrays
+        / tuples / LeafData objects -- every round-1..4 checkpoint and
+        .tree.pkl artifact) into the columnar layout."""
+        if "format" in state:
+            raise ValueError(
+                f"unsupported Tree pickle format {state['format']!r}")
+        self.p, self.n_u = state["p"], state["n_u"]
+        verts = state["vertices"]
+        n = len(verts)
+        self._n = n
+        self._alloc(max(self._INIT_CAP, n))
+        self._alloc_payload(self._INIT_CAP)
+        self._n_slots = 0
+        if n:
+            self._vertices[:n] = np.asarray(verts)
+            self._parent[:n] = np.asarray(state["parent"], dtype=np.int32)
+            self._children[:n] = np.asarray(state["children"],
+                                            dtype=np.int32)
+            self._depth[:n] = np.asarray(state["depth"], dtype=np.int32)
+            self._split_edge[:n] = np.asarray(state["split_edge"],
+                                              dtype=np.int8)
+        self._z_store, self._z_n = None, 0
+        self._n_regions = 0
+        self._max_depth = int(np.max(self._depth[:n])) if n else 0
+        leaf = state["leaf_data"]
+        for i, ld in enumerate(leaf):
+            if ld is None:
+                continue
+            # Old dataclass instances restore attribute-wise; pre-field
+            # pickles lack certified/semi_explicit (defaults True/False).
+            self.set_leaf(i, LeafData(
+                delta_idx=ld.delta_idx,
+                vertex_inputs=ld.vertex_inputs,
+                vertex_costs=ld.vertex_costs,
+                vertex_z=getattr(ld, "vertex_z", None),
+                certified=getattr(ld, "certified", True),
+                semi_explicit=getattr(ld, "semi_explicit", False)))
 
     def save(self, path: str) -> None:
         """Pickle to disk (the reference pickles its tree; SURVEY.md

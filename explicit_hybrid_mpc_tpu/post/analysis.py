@@ -27,28 +27,30 @@ def partition_report(tree: Tree, roots: list[int] | None = None) -> dict:
     truncated runs.
     """
     leaves = tree.leaves()
-    cert = [i for i in leaves if tree.leaf_data[i] is not None]
+    # Materialize each leaf's payload ONCE: every leaf_data[i] access
+    # builds a fresh LeafData view, and this report runs against
+    # multi-million-region trees.
+    lds = {i: tree.leaf_data[i] for i in leaves}
+    cert = [i for i in leaves if lds[i] is not None]
     # Semi-explicit boundary leaves (mixed vertex feasibility closed via
     # cfg.semi_explicit_boundary_depth): covered, online-guaranteed via
     # the fixed-delta QP, but NOT eps-certified -- reported separately
     # from both certified volume and depth-cap best-effort volume.
     semi = {i for i in cert
-            if getattr(tree.leaf_data[i], "semi_explicit", False)}
+            if getattr(lds[i], "semi_explicit", False)}
     # Depth-cap best-effort leaves carry a law but NO eps-certificate;
     # they must not inflate the certified-volume figure (getattr: trees
     # pickled before the `certified` field restore without it).
     best_effort = [i for i in cert if i not in semi
-                   and not getattr(tree.leaf_data[i], "certified", True)]
+                   and not getattr(lds[i], "certified", True)]
     vol = {i: geometry.simplex_volume(tree.vertices[i]) for i in leaves}
-    roots = roots if roots is not None else [
-        i for i in range(len(tree)) if tree.parent[i] < 0]
+    roots = roots if roots is not None else tree.roots()
     total = sum(geometry.simplex_volume(tree.vertices[r]) for r in roots)
     v_cert = (sum(vol[i] for i in cert) - sum(vol[i] for i in best_effort)
               - sum(vol[i] for i in semi))
     depths = np.asarray([tree.depth[i] for i in cert], dtype=np.int64)
-    per_delta = collections.Counter(
-        int(tree.leaf_data[i].delta_idx) for i in cert)
-    gaps = [float(np.ptp(tree.leaf_data[i].vertex_costs)) for i in cert]
+    per_delta = collections.Counter(int(lds[i].delta_idx) for i in cert)
+    gaps = [float(np.ptp(lds[i].vertex_costs)) for i in cert]
     return {
         "n_nodes": len(tree),
         "n_leaves": len(leaves),

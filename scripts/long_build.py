@@ -94,6 +94,11 @@ def run(result: dict, out_path: str) -> None:
         batch_simplices=batch, max_steps=10_000_000, max_depth=max_depth,
         semi_explicit_boundary_depth=boundary_depth,
         precision=precision,
+        # LONG_STORE_Z=0 drops the per-leaf primal matrices -- the
+        # largest leaf payload at cluster scale (~1 GB per 0.8M
+        # satellite leaves in RAM and per checkpoint); they feed offline
+        # soundness sampling, not the deployed controller.
+        store_vertex_z=os.environ.get("LONG_STORE_Z", "1") != "0",
         log_path=out_path.replace(".json", ".log.jsonl"))
     okw = dict(backend="device" if platform != "cpu" else "cpu",
                precision=precision, **sched_kw)

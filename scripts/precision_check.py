@@ -205,6 +205,11 @@ def run(result: dict) -> None:
                     tsol.Vstar[k]):
                 skipped += 1  # infeasible region / best-effort leaf
                 continue
+            if ld.vertex_z is None:
+                raise SystemExit(
+                    "soundness sampling needs per-leaf primal matrices; "
+                    "this tree was built with store_vertex_z=False "
+                    "(LONG_STORE_Z=0) -- rebuild with them on")
             lam = geometry.barycentric(tree.vertices[n], th)
             zbar = lam @ ld.vertex_z
             d = ld.delta_idx

@@ -93,3 +93,59 @@ def test_deep_bisection_stays_shape_regular():
 def test_kuhn_rejects_high_dim():
     with pytest.raises(ValueError):
         g.kuhn_triangulation(-np.ones(9), np.ones(9))
+
+
+def test_tree_columnar_roundtrip_and_legacy(tmp_path):
+    """Columnar tree (r5): O(1) counters, pickle round-trip, and
+    transparent loading of the pre-columnar list-of-objects layout
+    (every r1-r4 checkpoint and .tree.pkl artifact)."""
+    import pickle
+
+    from explicit_hybrid_mpc_tpu.partition.tree import (LeafData, NO_CHILD,
+                                                        Tree)
+
+    from explicit_hybrid_mpc_tpu.partition import geometry as geo
+
+    t = Tree(p=2, n_u=1)
+    V = np.array([[0.0, 0.0], [1.0, 0.0], [0.0, 1.0]])
+    r = t.add_root(V)
+    lv, rv, ei, ej, _mid = geo.bisect(V)
+    li, ri = t.split(r, lv, rv, (ei, ej))
+    t.set_leaf(li, LeafData(delta_idx=3, vertex_inputs=np.ones((3, 1)),
+                            vertex_costs=np.arange(3.0),
+                            vertex_z=np.full((3, 4), 2.0)))
+    t.set_leaf(ri, LeafData(delta_idx=1, vertex_inputs=np.zeros((3, 1)),
+                            vertex_costs=np.ones(3), certified=False,
+                            semi_explicit=True))
+    assert len(t) == 3 and t.n_regions() == 2 and t.max_depth() == 1
+    assert t.roots() == [r] and t.leaves() == [li, ri]
+    assert t.leaf_data[r] is None
+    assert t.leaf_data[li].delta_idx == 3
+    np.testing.assert_array_equal(t.leaf_data[li].vertex_z,
+                                  np.full((3, 4), 2.0))
+    assert t.leaf_data[ri].semi_explicit and not t.leaf_data[ri].certified
+    assert t.leaf_data[ri].vertex_z is None
+    # Round-trip through the columnar pickle format.
+    path = str(tmp_path / "t.pkl")
+    t.save(path)
+    t2 = Tree.load(path)
+    assert (len(t2), t2.n_regions(), t2.max_depth()) == (3, 2, 1)
+    np.testing.assert_array_equal(t2.vertices, t.vertices)
+    np.testing.assert_array_equal(t2.children, t.children)
+    assert t2.leaf_data[li].delta_idx == 3
+    # Legacy layout: simulate an old pickle's instance __dict__.
+    legacy = Tree.__new__(Tree)
+    legacy.__setstate__({
+        "p": 2, "n_u": 1,
+        "vertices": [np.asarray(t.vertices[i]) for i in range(3)],
+        "parent": [-1, 0, 0],
+        "children": [(1, 2), (NO_CHILD, NO_CHILD), (NO_CHILD, NO_CHILD)],
+        "depth": [0, 1, 1],
+        "split_edge": [(0, 1), (-1, -1), (-1, -1)],
+        "leaf_data": [None, t.leaf_data[li], t.leaf_data[ri]],
+    })
+    assert (len(legacy), legacy.n_regions()) == (3, 2)
+    assert legacy.is_leaf(1) and not legacy.is_leaf(0)
+    assert legacy.leaf_data[2].semi_explicit
+    np.testing.assert_array_equal(legacy.leaf_data[1].vertex_z,
+                                  np.full((3, 4), 2.0))

@@ -309,14 +309,10 @@ def test_batched_stage1_matches_scalar():
         nodes = list(eng.frontier)[:64]
         plan = eng._plan_missing(nodes)
         eng._consume_plan(plan, *eng._dispatch_plan(plan))
-        sds = {n: eng._vertex_data(n) for n in nodes}
+        sds, (bverts, bV, bconv, bgrad, _bu0, _bz, bVstar, bdstar) = \
+            eng._gather_batch(nodes)
         batch = certify.certify_stage1_batch(
-            np.stack([sds[n].verts for n in nodes]),
-            np.stack([sds[n].V for n in nodes]),
-            np.stack([sds[n].conv for n in nodes]),
-            np.stack([sds[n].grad for n in nodes]),
-            np.stack([sds[n].Vstar for n in nodes]),
-            np.stack([sds[n].dstar for n in nodes]),
+            bverts, bV, bconv, bgrad, bVstar, bdstar,
             cfg.eps_a, cfg.eps_r)
         for n, rb in zip(nodes, batch):
             rs = certify.certify_suboptimal_stage1(sds[n], cfg.eps_a,

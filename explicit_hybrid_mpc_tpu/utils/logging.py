@@ -13,10 +13,16 @@ from typing import IO, Optional
 
 
 class RunLog:
-    def __init__(self, path: Optional[str] = None, echo: bool = True):
+    def __init__(self, path: Optional[str] = None, echo: bool = True,
+                 base_t: float = 0.0):
+        """base_t: cumulative elapsed seconds from PREVIOUS sessions of a
+        resumed run.  Appending to an existing JSONL with base_t=0 resets
+        the `t` column mid-file and any d(regions)/d(t) consumer computes
+        garbage at the boundary; resume drivers (scripts/long_build.py)
+        pass their recovered cumulative wall so t stays monotonic."""
         self._fh: Optional[IO[str]] = open(path, "a") if path else None
         self._echo = echo
-        self.t0 = time.perf_counter()
+        self.t0 = time.perf_counter() - base_t
 
     def emit(self, **fields) -> None:
         rec = {"t": round(time.perf_counter() - self.t0, 4), **fields}

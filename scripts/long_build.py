@@ -112,9 +112,27 @@ def run(result: dict, out_path: str) -> None:
     else:
         oracle = Oracle(problem, **okw)
         result["prune_rows"] = False
-    runlog = RunLog(cfg.log_path, echo=False)
     base_wall = 0.0
-    if os.path.exists(ckpt):
+    resuming = os.path.exists(ckpt)
+    if resuming:
+        # Cumulative build wall from the PREVIOUS sessions' artifact:
+        # without it a resumed run reports session-local wall against
+        # cumulative region counts and the regions/s evidence is
+        # inflated by orders of magnitude.  Recovered BEFORE RunLog so
+        # the JSONL `t` column continues monotonically across the
+        # append boundary instead of resetting mid-file.
+        try:
+            with open(out_path) as f:
+                prev = json.load(f)
+            rows = prev.get("progress", [])
+            base_wall = float(rows[-1]["wall_s"]) if rows else float(
+                prev.get("stats", {}).get("wall_s", 0.0))
+            result["progress"] = rows
+        except Exception:
+            pass
+        result["resumed_base_wall_s"] = round(base_wall, 1)
+    runlog = RunLog(cfg.log_path, echo=False, base_t=base_wall)
+    if resuming:
         log(f"resuming from {ckpt}")
         import pickle
 
@@ -136,20 +154,6 @@ def run(result: dict, out_path: str) -> None:
         eng = FrontierEngine.resume(snap, problem, oracle, log=runlog,
                                     cfg=cfg)
         result["resumed_from_step"] = eng.steps
-        # Cumulative build wall from the PREVIOUS sessions' artifact:
-        # without it a resumed run reports session-local wall against
-        # cumulative region counts and the regions/s evidence is
-        # inflated by orders of magnitude.
-        try:
-            with open(out_path) as f:
-                prev = json.load(f)
-            rows = prev.get("progress", [])
-            base_wall = float(rows[-1]["wall_s"]) if rows else float(
-                prev.get("stats", {}).get("wall_s", 0.0))
-            result["progress"] = rows
-        except Exception:
-            pass
-        result["resumed_base_wall_s"] = round(base_wall, 1)
     else:
         eng = FrontierEngine(problem, oracle, cfg, log=runlog)
 

@@ -461,6 +461,24 @@ class Oracle:
                 lambda th, d: _solve_one(self.prob, th, d,
                                          self.rescue_iter, 0))
 
+    def cpu_twin(self, problem) -> "Oracle":
+        """CPU re-instantiation with identical solver semantics -- the
+        frontier's device-failure fallback retries failed device batches
+        on it, so results must be bit-compatible with this oracle's.
+        Subclasses with different kernels (SOCOracle) MUST override:
+        falling back to the plain QP kernel would silently change what
+        the certificates are built from."""
+        return Oracle(
+            problem, backend="cpu",
+            n_iter=self.n_iter + self.n_f32,
+            precision=self.precision,
+            # Mirror an overridden f32/f64 split exactly, else the
+            # fallback's results drift from the main oracle's.
+            n_f32=(self.n_f32 if self.precision == "mixed" else None),
+            points_cap=self.points_cap,
+            rescue_iter=self.rescue_iter,
+            point_schedule=self.point_schedule)
+
     @staticmethod
     def _scaled_cond(H: np.ndarray) -> float:
         """Worst condition number over commutations of the Jacobi-scaled

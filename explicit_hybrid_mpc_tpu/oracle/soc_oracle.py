@@ -1,0 +1,126 @@
+"""Full partition oracle for SOC-constrained hybrid problems.
+
+Round-4 verdict "missing #3" / docs/socp_scope.md item 1: extend the
+eps-suboptimal partition pipeline from polyhedral QPs to the reference's
+full mixed-integer QP/SOCP class (SURVEY.md section 1 [P]; mount empty,
+no file:line exists).  The design splits the oracle's query classes by
+what each certificate actually needs:
+
+- POINT class (vertex grids, sparse pairs, fixed-commutation online):
+  the exact SOCP kernel (oracle/socp.py, NT-scaled Mehrotra + verified
+  tangent-cone rescue).  `conv` is the strict 1e-8 KKT flag, and the
+  envelope gradient dV/dtheta = F'z* + Y theta + p - S'lam* is
+  certificate-grade (the cones are theta-INDEPENDENT, so they add no
+  gradient term; measured fd error <= 1e-6 relative on satellite_soc).
+
+- JOINT simplex class (stage-2 lower bounds, Farkas exclusions): the
+  LINEAR RELAXATION, inherited verbatim from the QP Oracle.  Dropping
+  theta-independent cones RELAXES the feasible set, so
+    (a) the relaxation's simplex-min is a valid LOWER bound on the true
+        SOC simplex-min (certificates use it on the lower-bound side
+        only -- sound, possibly loose: extra splits, never a wrong
+        certificate), and
+    (b) a linear-Farkas infeasibility certificate on the relaxation
+        implies SOC infeasibility (fewer constraints infeasible =>
+        more constraints infeasible).
+
+- Upper-bound side: a certified leaf interpolates the vertex primal
+  sequences; each vertex z_i satisfies the cones and the cones are
+  convex and theta-independent, so every barycentric combination does
+  too -- the QP certificate argument carries over unchanged.
+
+Stalled point cells (~2-5% of satellite_soc grid cells after the
+tangent rescue) report conv=False and simply weaken the certificate at
+that vertex -- the engine splits more in stall pockets (and can close
+boundary shells semi-explicitly); soundness is unaffected.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from explicit_hybrid_mpc_tpu.oracle import oracle as omod
+from explicit_hybrid_mpc_tpu.oracle.oracle import Oracle
+from explicit_hybrid_mpc_tpu.oracle.socp import socp_solve
+
+
+class SOCOracle(Oracle):
+    """Oracle for problems exposing `soc_cones()` (Ac (K, m, nz),
+    bc (K, m), theta-independent).  Single-device batched backends only
+    (the SOC kernel is f64 and not mesh-sharded yet)."""
+
+    def __init__(self, problem, soc_n_iter: int = 60, **kw):
+        if kw.get("backend") == "serial" or kw.get("mesh") is not None:
+            raise ValueError("SOCOracle supports batched single-device "
+                             "backends only")
+        if kw.get("rescue_iter") or kw.get("point_schedule"):
+            # The base class's rescue/schedule programs are built from the
+            # cone-blind linear kernel: a rescue pass would overwrite
+            # stalled SOC cells with LP-relaxation results flagged
+            # converged -- silently unsound certificates.
+            raise ValueError("SOCOracle does not support rescue_iter or "
+                             "point_schedule (linear-kernel programs)")
+        kw.setdefault("precision", "f64")  # SOC kernel is f64-only
+        super().__init__(problem, **kw)
+        self._soc_n_iter = soc_n_iter
+        Ac, bc = problem.soc_cones()
+        prob = self.prob  # device-side canonical arrays
+        Acj = jax.device_put(jnp.asarray(Ac), self.device)
+        bcj = jax.device_put(jnp.asarray(bc), self.device)
+
+        def point_one(theta, d):
+            q = prob.f[d] + prob.F[d] @ theta
+            b = prob.w[d] + prob.S[d] @ theta
+            sol = socp_solve(prob.H[d], q, prob.G[d], b, Acj, bcj,
+                             n_iter=soc_n_iter)
+            tc = (0.5 * theta @ prob.Y[d] @ theta
+                  + prob.pvec[d] @ theta + prob.cconst[d])
+            grad = (prob.F[d].T @ sol.z + prob.Y[d] @ theta
+                    + prob.pvec[d] - prob.S[d].T @ sol.lam_l)
+            u0 = (prob.u_map[d] @ sol.z + prob.u_theta[d] @ theta
+                  + prob.u_const[d])
+            return (sol.obj + tc, sol.converged, sol.feasible, grad, u0,
+                    sol.z)
+
+        def points_all(_prob, thetas):
+            # Same signature and 8 outputs as _solve_points_all_deltas so
+            # the base class's chunking/padding/prefetch machinery works
+            # untouched (_prob ignored: the closure holds device arrays).
+            nd = self.can.n_delta
+            V, conv, feas, grad, u0, z = jax.vmap(lambda th: jax.vmap(
+                lambda d: point_one(th, d))(jnp.arange(nd)))(thetas)
+            # Shared first-minimum tie-break; _finalize applies the
+            # dstar=-1 masking exactly as on the QP path.
+            Vstar, dstar = omod.reduce_deltas(V, conv)
+            return V, conv, feas, grad, u0, z, Vstar, dstar
+
+        # Replace the POINT-class programs with the SOC kernel; the
+        # JOINT simplex programs (self._simplex_min / _simplex_feas,
+        # built by super().__init__) stay on the linear relaxation by
+        # design (module docstring).
+        self._solve_points = jax.jit(points_all)
+        self._solve_one_point = jax.jit(
+            lambda _prob, theta: points_all(_prob, theta[None]))
+        self._solve_fixed = jax.jit(jax.vmap(point_one, in_axes=(0, 0)))
+        self._solve_pair_one = jax.jit(point_one)
+
+    def cpu_twin(self, problem) -> "SOCOracle":
+        # Device-failure fallback (frontier._fallback_oracle): the twin
+        # must run the SAME exact SOC kernel -- a plain QP twin would
+        # silently replace cone solves with the linear relaxation and
+        # certify cone-violating leaves.
+        return SOCOracle(problem, soc_n_iter=self._soc_n_iter,
+                         backend="cpu", points_cap=self.points_cap)
+
+    def point_feasibility(self, thetas, delta_idx):
+        # The base implementation is phase-1 on the LINEAR rows: its
+        # "feasible" verdict would be unsound for a cone-constrained
+        # problem (LP-feasible does not imply SOC-feasible).  Only the
+        # feasibility-only ('feasible'/ECC) algorithm calls this; that
+        # variant stays QP-scope.
+        raise NotImplementedError(
+            "feasibility-only variant is QP-scope; SOC partitions run "
+            "the 'suboptimal' algorithm (docs/socp_scope.md)")

@@ -158,19 +158,11 @@ class FrontierEngine:
     def _fallback_oracle(self) -> Oracle:
         """Lazily built CPU twin of the main oracle: same kernel, same
         precision schedule, CPU devices -- results are bit-compatible, so
-        retrying a failed device batch on it preserves build parity."""
+        retrying a failed device batch on it preserves build parity.
+        Built by the oracle's own cpu_twin so subclassed kernels
+        (SOCOracle) fall back to THEMSELVES, not the plain QP kernel."""
         if self._fb_oracle is None:
-            self._fb_oracle = Oracle(
-                self.problem, backend="cpu",
-                n_iter=self.oracle.n_iter + self.oracle.n_f32,
-                precision=self.oracle.precision,
-                # Mirror an overridden f32/f64 split exactly, else the
-                # fallback's results drift from the main oracle's.
-                n_f32=(self.oracle.n_f32
-                       if self.oracle.precision == "mixed" else None),
-                points_cap=self.oracle.points_cap,
-                rescue_iter=self.oracle.rescue_iter,
-                point_schedule=self.oracle.point_schedule)
+            self._fb_oracle = self.oracle.cpu_twin(self.problem)
         return self._fb_oracle
 
     def _oracle_call(self, method: str, *args):
@@ -279,11 +271,11 @@ class FrontierEngine:
                     need[k] = full if act is full else (cur | act)
         grid_pts: list[np.ndarray] = []
         grid_keys: list[bytes] = []
-        pair_t: list[np.ndarray] = []
-        pair_d: list[int] = []
+        pair_verts: list[np.ndarray] = []
+        pair_ds: list[np.ndarray] = []
         # (key, delta indices, offset into the pair batch)
         pair_slices: list[tuple[bytes, np.ndarray, int]] = []
-        n_skips = n_new = 0
+        n_pair = n_skips = n_new = 0
         for k, m in need.items():
             row = self.cache.get_key(k)
             if row is None:
@@ -304,12 +296,25 @@ class FrontierEngine:
                 # distinct vertices ever solved, same meaning as the
                 # unmasked build's.
                 n_new += 1
-            pair_slices.append((k, ds, len(pair_d)))
-            pair_t.extend([vert[k]] * ds.size)
-            pair_d.extend(ds.tolist())
+            pair_slices.append((k, ds, n_pair))
+            pair_verts.append(vert[k])
+            pair_ds.append(ds)
+            n_pair += ds.size
         if not grid_pts and not pair_slices:
             return None
-        return {"grid_pts": grid_pts, "grid_keys": grid_keys,
+        # Batches are stacked ONCE here (np.repeat over the unique-vertex
+        # stack for the pair rows): dispatch re-stacking per-element
+        # python lists -- and consume stacking them AGAIN for the
+        # fallback args -- was the largest host cost of pure-splitting
+        # phases (~6k np.asarray calls per step via np.stack).
+        grid_arr = np.stack(grid_pts) if grid_pts else None
+        if pair_slices:
+            counts = np.asarray([d.size for d in pair_ds])
+            pair_t = np.repeat(np.stack(pair_verts), counts, axis=0)
+            pair_d = np.concatenate(pair_ds).astype(np.int64)
+        else:
+            pair_t = pair_d = None
+        return {"grid_arr": grid_arr, "grid_keys": grid_keys,
                 "pair_t": pair_t, "pair_d": pair_d,
                 "pair_slices": pair_slices,
                 "n_skips": n_skips, "n_new": n_new + len(grid_pts)}
@@ -323,13 +328,11 @@ class FrontierEngine:
         gh = ph = None
         t0 = time.perf_counter()
         try:
-            if plan["grid_pts"]:
-                gh = self.oracle.dispatch_vertices(
-                    np.stack(plan["grid_pts"]))
+            if plan["grid_arr"] is not None:
+                gh = self.oracle.dispatch_vertices(plan["grid_arr"])
             if plan["pair_slices"]:
-                ph = self.oracle.dispatch_pairs(
-                    np.stack(plan["pair_t"]),
-                    np.asarray(plan["pair_d"], dtype=np.int64))
+                ph = self.oracle.dispatch_pairs(plan["pair_t"],
+                                                plan["pair_d"])
         except (RuntimeError, OSError) as e:
             # Mark BOTH parts failed: a raising tunnel rarely delivers
             # the part that did not raise, and the fallback recomputes
@@ -352,18 +355,16 @@ class FrontierEngine:
         self.n_point_skips += plan["n_skips"]
         t0 = time.perf_counter()
         try:
-            if plan["grid_pts"]:
+            if plan["grid_arr"] is not None:
                 sol: VertexSolution = self._wait_or_fallback(
-                    "vertices", gh, (np.stack(plan["grid_pts"]),))
+                    "vertices", gh, (plan["grid_arr"],))
                 for i, k in enumerate(plan["grid_keys"]):
                     self.cache.put_key(
                         k, (sol.V[i], sol.conv[i], sol.grad[i], sol.u0[i],
                             sol.z[i], sol.Vstar[i], sol.dstar[i], full))
             if plan["pair_slices"]:
                 V, conv, grad, u0, z = self._wait_or_fallback(
-                    "pairs", ph,
-                    (np.stack(plan["pair_t"]),
-                     np.asarray(plan["pair_d"], dtype=np.int64)))
+                    "pairs", ph, (plan["pair_t"], plan["pair_d"]))
                 nt, nu, nz = (self.problem.n_theta, self.problem.n_u,
                               self.oracle.can.nz)
                 for k, ds, lo in plan["pair_slices"]:

@@ -130,10 +130,17 @@ def longest_edge(V: np.ndarray) -> tuple[int, int]:
     for backend-parity of the produced tree.
     """
     n = V.shape[0]
+    # One vectorized pass for the pairwise squared lengths (the python
+    # np.dot double loop was ~150 ms/step at cluster-scale batch sizes);
+    # the selection loop below runs on plain floats and keeps the exact
+    # sequential tie-break semantics.
+    D = V[:, None, :] - V[None, :, :]
+    d2 = np.einsum("ijk,ijk->ij", D, D)
     best = (-1.0, 0, 1)
     for i in range(n):
+        row = d2[i]
         for j in range(i + 1, n):
-            d = float(np.dot(V[i] - V[j], V[i] - V[j]))
+            d = row[j]
             # Strict > with a RELATIVE margin keeps the lexicographically
             # first pair on ties at ANY scale: squared edge lengths shrink
             # ~4x per bisection level, so an absolute epsilon would turn

@@ -94,3 +94,90 @@ def test_online_fixed_delta_closed_loop(soc_problem, points):
             "applied transverse wheel torque violates the cone")
         x = soc_problem.plant_step(x, u)
         assert np.all(np.isfinite(x))
+
+
+# -- r5: certified SOC partitions (oracle/soc_oracle.py) --------------------
+
+def test_soc_oracle_vertex_solution_matches_point_oracle():
+    """SOCOracle's point grid must agree with the proven SOCPointOracle
+    on values and commutation choice, while adding certificate-grade
+    gradients and the strict conv flag the partition engine needs."""
+    import numpy as np
+
+    from explicit_hybrid_mpc_tpu.oracle.soc_oracle import SOCOracle
+    from explicit_hybrid_mpc_tpu.oracle.soc_point import SOCPointOracle
+    from explicit_hybrid_mpc_tpu.problems.registry import make
+
+    prob = make("satellite_soc", N=3)
+    o1 = SOCOracle(prob, backend="cpu")
+    o2 = SOCPointOracle(prob)
+    rng = np.random.default_rng(0)
+    ths = rng.uniform(prob.theta_lb, prob.theta_ub,
+                      size=(4, prob.n_theta))
+    s1 = o1.solve_vertices(ths)
+    V2, _usable2, _u02, _Vstar2, dstar2 = o2.solve_vertices(ths)
+    m = s1.conv
+    assert m.mean() > 0.9, "tangent rescue regressed strict convergence"
+    np.testing.assert_allclose(s1.V[m], V2[m], rtol=1e-6, atol=1e-8)
+    np.testing.assert_array_equal(s1.dstar, dstar2)
+
+
+def test_soc_envelope_gradients_match_finite_differences():
+    import numpy as np
+
+    from explicit_hybrid_mpc_tpu.oracle.soc_oracle import SOCOracle
+    from explicit_hybrid_mpc_tpu.problems.registry import make
+
+    prob = make("satellite_soc", N=3)
+    o = SOCOracle(prob, backend="cpu")
+    rng = np.random.default_rng(3)
+    th = rng.uniform(0.5 * prob.theta_lb, 0.5 * prob.theta_ub)
+    sol = o.solve_vertices(th[None])
+    d = int(sol.dstar[0])
+    assert d >= 0 and sol.conv[0, d]
+    g = sol.grad[0, d]
+    eps = 1e-5
+    for ax in range(prob.n_theta):
+        e = np.zeros(prob.n_theta)
+        e[ax] = eps
+        Vp = o.solve_vertices((th + e)[None]).V[0, d]
+        Vm = o.solve_vertices((th - e)[None]).V[0, d]
+        fd = (Vp - Vm) / (2 * eps)
+        assert abs(fd - g[ax]) / (1 + abs(fd)) < 1e-5, (ax, fd, g[ax])
+
+
+def test_soc_partition_certifies_slice():
+    """End-to-end eps-certified partition over an SOC problem: the full
+    QP/SOCP MICP class (SURVEY.md section 1 [P]; r4 verdict missing #3).
+    Joint stage-2/Farkas queries run on the LINEAR RELAXATION (sound
+    lower bounds; soc_oracle.py docstring)."""
+    from explicit_hybrid_mpc_tpu.config import PartitionConfig
+    from explicit_hybrid_mpc_tpu.oracle.soc_oracle import SOCOracle
+    from explicit_hybrid_mpc_tpu.partition.frontier import build_partition
+    from explicit_hybrid_mpc_tpu.problems.registry import make
+
+    prob = make("satellite_soc", N=3, h_box=0.15, omega_box=0.015)
+    cfg = PartitionConfig(problem="satellite_soc", eps_a=4.0, eps_r=0.5,
+                          backend="cpu", batch_simplices=64, max_depth=16,
+                          max_steps=4000, semi_explicit_boundary_depth=8,
+                          time_budget_s=300)
+    res = build_partition(prob, cfg,
+                          oracle=SOCOracle(prob, backend="cpu"))
+    assert res.stats["regions"] > 50
+    assert res.stats["uncertified"] == 0
+
+
+def test_soc_oracle_rejects_serial_and_mesh():
+    import pytest as _pytest
+
+    from explicit_hybrid_mpc_tpu.oracle.soc_oracle import SOCOracle
+    from explicit_hybrid_mpc_tpu.problems.registry import make
+
+    prob = make("satellite_soc", N=3)
+    with _pytest.raises(ValueError, match="single-device"):
+        SOCOracle(prob, backend="serial")
+    with _pytest.raises(ValueError, match="rescue_iter"):
+        SOCOracle(prob, backend="cpu", rescue_iter=30)
+    with _pytest.raises(NotImplementedError, match="QP-scope"):
+        SOCOracle(prob, backend="cpu").point_feasibility(
+            prob.theta_lb[None], [0])

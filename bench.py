@@ -97,9 +97,20 @@ def host_cpu_fingerprint() -> str:
 
 def cpu_cache_dir(base: str | None = None) -> str:
     """Host-fingerprinted persistent-cache directory for the CPU backend
-    (shared by choose_backend and tests/conftest.py)."""
+    (shared by choose_backend and tests/conftest.py).
+
+    Keyed by the forced host-platform device count too: the 8-virtual-
+    device client the test suite uses compiles XLA:CPU AOT results with
+    different lowering preferences (+prefer-no-scatter/-gather) than the
+    single-device clients, and loading across that split trips the same
+    machine-type SIGILL-risk rejection as a foreign host would."""
+    import re
+
+    m = re.search(r"host_platform_device_count=(\d+)",
+                  os.environ.get("XLA_FLAGS", ""))
+    n = m.group(1) if m else "1"
     return os.path.join(base or CACHE_DIR,
-                        "cpu-" + host_cpu_fingerprint())
+                        f"cpu-{host_cpu_fingerprint()}-d{n}")
 
 
 class ContentionMonitor:
@@ -243,7 +254,7 @@ def choose_backend(result: dict | None = None) -> str:
     # spends its deadline measuring instead of compiling.
     cache_dir = os.environ.get("JAX_COMPILATION_CACHE_DIR", CACHE_DIR)
     if (chosen == "cpu" and os.path.basename(cache_dir)
-            != "cpu-" + host_cpu_fingerprint()):
+            != os.path.basename(cpu_cache_dir())):
         # XLA:CPU executables are host-feature-specific; key the CPU
         # cache by the host fingerprint so a cache written on another
         # machine type can never be loaded here (r4 weak #8: SIGILL-risk

@@ -28,6 +28,14 @@ flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
 os.environ["XLA_FLAGS"] = (
     flags + " --xla_force_host_platform_device_count=4").strip()
 
+# Re-key the persistent compile cache for THIS process's client shape
+# (d4): the inherited env var points at the parent suite's dir, and
+# XLA:CPU AOT results are host- and device-count-specific (bench.
+# cpu_cache_dir rationale).
+from bench import cpu_cache_dir  # noqa: E402
+
+os.environ["JAX_COMPILATION_CACHE_DIR"] = cpu_cache_dir()
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")

@@ -116,7 +116,15 @@ def test_cpu_cache_dir_is_host_fingerprinted():
         fp = host_cpu_fingerprint()
         assert len(fp) == 12 and fp == host_cpu_fingerprint()  # stable
         d = cpu_cache_dir()
-        assert os.path.basename(d) == "cpu-" + fp
+        # Suite processes run a forced-device-count client (conftest
+        # sets XLA_FLAGS when absent), whose AOT lowering prefs differ
+        # from other counts' -- the key must carry the ACTIVE count.
+        import re as _re
+
+        m = _re.search(r"host_platform_device_count=(\d+)",
+                       os.environ.get("XLA_FLAGS", ""))
+        n = m.group(1) if m else "1"
+        assert os.path.basename(d) == f"cpu-{fp}-d{n}"
         # conftest pins the forced-CPU in-process tests to the
         # fingerprinted dir (the env var stays the shared base for
         # accelerator subprocesses).

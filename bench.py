@@ -221,7 +221,8 @@ def probe_backend(timeout_s: float) -> str | None:
     return None
 
 
-def choose_backend(result: dict | None = None) -> str:
+def choose_backend(result: dict | None = None,
+                   hold_capture_sentinel: bool = True) -> str:
     """Select and initialize the jax backend, unkillably.
 
     BENCH_PLATFORM forces a backend (skips the probe); otherwise the
@@ -229,8 +230,20 @@ def choose_backend(result: dict | None = None) -> str:
     backend.  Records probe/init failures into `result` when given.
     Returns the platform actually in use.  Shared by bench.py and every
     scripts/ capture tool so the fallback behaviour cannot drift.
+
+    hold_capture_sentinel=True (the default) additionally acquires the
+    capture-active sentinel for the REST OF THE PROCESS (released at
+    exit): every capture script that measures anything goes through
+    this function, and on the one-core host an unpaused concurrent
+    long_build silently halves whatever a capture measures (observed
+    twice: the r4 driver bench at 259-vs-505 r/s, and several r5
+    configs rows).  long_build itself -- the pausee -- passes False.
     """
     result = result if result is not None else {}
+    if hold_capture_sentinel:
+        import atexit
+
+        atexit.register(hold_sentinel())
     forced = os.environ.get("BENCH_PLATFORM")
     if forced:
         chosen = forced

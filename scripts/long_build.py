@@ -64,7 +64,9 @@ def run(result: dict, out_path: str) -> None:
     max_depth = int(os.environ.get("LONG_MAX_DEPTH", "64"))
     bd_env = os.environ.get("LONG_BOUNDARY_DEPTH")
     boundary_depth = int(bd_env) if bd_env else None
-    platform = choose_backend(result)
+    # hold_capture_sentinel=False: long_build is the PAUSEE of the
+    # capture-sentinel protocol, not a capture.
+    platform = choose_backend(result, hold_capture_sentinel=False)
 
     from bench import default_precision
 

@@ -99,8 +99,8 @@ def export_descent(tree: Tree, roots: list[int],
     offset = np.zeros(Nn)
     internal = np.flatnonzero(children[:, 0] != NO_CHILD)
     if internal.size:
-        Vs = np.stack([tree.vertices[n] for n in internal])   # (Ni, p+1, p)
-        ij = np.asarray([tree.split_edge[n] for n in internal])  # (Ni, 2)
+        Vs = np.asarray(tree.vertices[internal])              # (Ni, p+1, p)
+        ij = np.asarray(tree.split_edge[internal], dtype=np.int64)  # (Ni, 2)
         ar = np.arange(internal.size)
         mid = 0.5 * (Vs[ar, ij[:, 0]] + Vs[ar, ij[:, 1]])     # (Ni, p)
         if p == 1:
@@ -124,8 +124,8 @@ def export_descent(tree: Tree, roots: list[int],
         offset[internal] = c / nrm
     leaf_row = np.full(Nn, -1, dtype=np.int32)
     leaf_row[table.node_id] = np.arange(table.n_leaves, dtype=np.int32)
-    root_bary = np.stack([geometry.barycentric_matrix(tree.vertices[r])
-                          for r in roots])
+    root_bary = geometry.barycentric_matrices(
+        tree.vertices[np.asarray(roots, dtype=np.int64)])
     return DescentTable(
         root_bary=jnp.asarray(root_bary),
         root_node=jnp.asarray(np.asarray(roots, dtype=np.int32)),

@@ -40,20 +40,19 @@ class LeafTable(NamedTuple):
 
 
 def export_leaves(tree: Tree) -> LeafTable:
+    """Fully vectorized over the columnar tree: batched barycentric
+    inverses + payload fancy-indexing.  The per-leaf python loop this
+    replaces built 3L small arrays in lists and OOM'd the 9.8M-leaf
+    satellite full-box export next to the live tree."""
     ids = tree.converged_leaves()
     if not ids:
         raise ValueError("tree has no converged leaves")
-    Ms, Us, Vs, ds = [], [], [], []
-    for n in ids:
-        Ms.append(geometry.barycentric_matrix(tree.vertices[n]))
-        ld = tree.leaf_data[n]
-        Us.append(ld.vertex_inputs)
-        Vs.append(ld.vertex_costs)
-        ds.append(ld.delta_idx)
+    ids = np.asarray(ids, dtype=np.int64)
+    delta, U, V = tree.leaf_payloads(ids)
     return LeafTable(
-        bary_M=np.stack(Ms), U=np.stack(Us), V=np.stack(Vs),
-        delta=np.asarray(ds, dtype=np.int32),
-        node_id=np.asarray(ids, dtype=np.int32))
+        bary_M=geometry.barycentric_matrices(tree.vertices[ids]),
+        U=U, V=V, delta=delta.astype(np.int32),
+        node_id=ids.astype(np.int32))
 
 
 def semi_explicit_mask(tree: Tree, table: LeafTable) -> np.ndarray:
@@ -62,7 +61,8 @@ def semi_explicit_mask(tree: Tree, table: LeafTable) -> np.ndarray:
     Those rows' interpolated laws are fallbacks only; the deployed
     controller must route them through the online fixed-delta QP
     (sim.SemiExplicitController(semi_mask=...)).  Kept out of LeafTable
-    itself so pure eps-certified partitions pay nothing.
-    """
-    return np.array([getattr(tree.leaf_data[int(n)], "semi_explicit", False)
-                     for n in table.node_id], dtype=bool)
+    itself so pure eps-certified partitions pay nothing.  Reads the
+    flags column directly (a per-leaf python loop here would undo the
+    vectorized export at cluster scale -- main.py calls this right
+    after export_leaves)."""
+    return tree.semi_explicit_flags(table.node_id)

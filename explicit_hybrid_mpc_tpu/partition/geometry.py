@@ -103,6 +103,26 @@ def barycentric_matrix(V: np.ndarray) -> np.ndarray:
     return np.linalg.inv(A)
 
 
+def barycentric_matrices(Vs: np.ndarray,
+                         chunk: int = 1 << 20) -> np.ndarray:
+    """Batched barycentric_matrix: (L, p+1, p) -> (L, p+1, p+1).
+
+    One batched inverse per chunk instead of a per-leaf python loop --
+    the loop (plus its L small-array intermediates) is what blew the
+    online export past host RAM at the 9.8M-leaf satellite full-box
+    ledger.  Chunking bounds the transient [V^T; 1] stack."""
+    Vs = np.asarray(Vs, dtype=np.float64)
+    L, m, p = Vs.shape
+    out = np.empty((L, m, m), dtype=np.float64)
+    for lo in range(0, L, chunk):
+        Vc = Vs[lo:lo + chunk]
+        A = np.concatenate(
+            [Vc.transpose(0, 2, 1),
+             np.ones((Vc.shape[0], 1, m), dtype=np.float64)], axis=1)
+        out[lo:lo + chunk] = np.linalg.inv(A)
+    return out
+
+
 def barycentric(V: np.ndarray, theta: np.ndarray) -> np.ndarray:
     """Barycentric coordinates of theta w.r.t. simplex V ((p+1,p))."""
     M = barycentric_matrix(V)

@@ -298,6 +298,29 @@ class Tree:
             self._pl_zidx[s] = self._z_n
             self._z_n += 1
 
+    def leaf_payloads(self, ids: np.ndarray
+                      ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """(delta (L,), vertex_inputs (L, p+1, n_u), vertex_costs
+        (L, p+1)) for payload-carrying leaf ids, by columnar fancy
+        indexing -- the per-leaf LeafData materialization loop was the
+        online export's memory blow-up at cluster scale."""
+        ids = np.asarray(ids, dtype=np.int64)
+        slots = self._leaf_slot[ids]
+        if ids.size and slots.min() < 0:
+            raise ValueError("leaf_payloads: id without payload")
+        # Fancy indexing returns fresh arrays -- no aliasing of tree
+        # storage in any of the three.
+        return (self._pl_delta[slots],
+                self._pl_inputs[slots],
+                self._pl_costs[slots])
+
+    def semi_explicit_flags(self, ids: np.ndarray) -> np.ndarray:
+        """(L,) bool: semi-explicit boundary flag per node id, from the
+        flags column (the per-leaf LeafData loop this replaces ran right
+        after every export at cluster scale)."""
+        ids = np.asarray(ids, dtype=np.int64)
+        return (self._leaf_flags[ids] & _F_SEMI) != 0
+
     # -- queries -----------------------------------------------------------
 
     def __len__(self) -> int:

@@ -149,3 +149,19 @@ def test_tree_columnar_roundtrip_and_legacy(tmp_path):
     assert legacy.leaf_data[2].semi_explicit
     np.testing.assert_array_equal(legacy.leaf_data[1].vertex_z,
                                   np.full((3, 4), 2.0))
+
+
+def test_barycentric_matrices_match_scalar():
+    """Batched export path (r5): one batched inverse must reproduce the
+    per-leaf barycentric_matrix exactly (same np.linalg kernel)."""
+    from explicit_hybrid_mpc_tpu.partition import geometry as geo
+
+    rng = np.random.default_rng(5)
+    for p in (1, 2, 4, 6):
+        Vs = rng.uniform(-2, 2, size=(17, p + 1, p))
+        # Keep simplices nondegenerate: nudge towards identity corners.
+        Vs += np.eye(p + 1, p)[None] * 3.0
+        B = geo.barycentric_matrices(Vs, chunk=5)  # exercise chunking
+        for i in range(Vs.shape[0]):
+            np.testing.assert_allclose(
+                B[i], geo.barycentric_matrix(Vs[i]), rtol=1e-12, atol=1e-12)

@@ -122,6 +122,13 @@ class PartitionConfig:
     # 0.8M satellite leaves), so multi-million-region campaigns can turn
     # them off (scripts/long_build.py LONG_STORE_Z=0).
     store_vertex_z: bool = True
+    # Compute each split's descent hyperplane AT SPLIT TIME (one small
+    # nullspace solve inside Tree.split, amortized into the device-bound
+    # build) so online.descent.export_descent is pure array slicing
+    # instead of a post-hoc batched SVD over every internal node (1129 s
+    # at the 9.8M-leaf satellite).  False exists for the parity tests
+    # and for measuring the amortized cost itself.
+    split_hyperplanes: bool = True
     seed: int = 0
 
     def __post_init__(self) -> None:

@@ -6,16 +6,42 @@ a flat table of leaves -- per leaf the barycentric matrix (lambda =
 bary_M @ [theta;1]) and the vertex input matrix -- so point location +
 affine evaluation is one fixed-shape device program (BASELINE.json
 north-star: "a Pallas point-in-simplex + affine-eval kernel").
+
+Two export shapes share one chunked core (`_fill_chunks`):
+
+- `export_leaves(tree)` materializes the table in RAM (small/medium
+  partitions, tests, the benchmark's flagship tree);
+- `write_leaf_table(tree, dir)` streams the SAME chunks into
+  memory-mapped ``.npy`` files, so exporting a multi-million-leaf tree
+  next to its live 45 GB in-RAM form costs O(chunk) additional RSS, not
+  a second O(L) copy (the 9.8M-leaf satellite export peaked at 94.8 GB
+  host RSS with the in-RAM path -- commit 0ff2285).  `load_leaf_table`
+  maps the files back (optionally copy-free) so the online stage never
+  needs the pickled tree at all.
+
+Chunk boundaries do not change a single bit of the output: every field
+is computed row-independently (batched inverses per chunk, columnar
+fancy indexing), which tests/test_online.py pins against the in-RAM
+export.
 """
 
 from __future__ import annotations
 
+import json
+import os
 from typing import NamedTuple
 
 import numpy as np
 
 from explicit_hybrid_mpc_tpu.partition import geometry
 from explicit_hybrid_mpc_tpu.partition.tree import Tree
+
+# Streaming chunk: 2^18 leaves x (bary_M + U + V) is ~20-80 MB transient
+# for the benchmark problems -- large enough that the per-chunk batched
+# inverse amortizes, small enough that export RSS stays flat.
+DEFAULT_CHUNK = 1 << 18
+
+_LEAF_FIELDS = ("bary_M", "U", "V", "delta", "node_id")
 
 
 class LeafTable(NamedTuple):
@@ -26,6 +52,9 @@ class LeafTable(NamedTuple):
     V:        (L, p+1)      -- vertex costs (for cost readout)
     delta:    (L,)          -- commutation index per leaf
     node_id:  (L,)          -- tree node of each row (for cross-checks)
+
+    Arrays may be np.memmap views of an on-disk table (load_leaf_table);
+    the contract is identical either way.
     """
 
     bary_M: np.ndarray
@@ -39,20 +68,100 @@ class LeafTable(NamedTuple):
         return self.bary_M.shape[0]
 
 
-def export_leaves(tree: Tree) -> LeafTable:
-    """Fully vectorized over the columnar tree: batched barycentric
-    inverses + payload fancy-indexing.  The per-leaf python loop this
-    replaces built 3L small arrays in lists and OOM'd the 9.8M-leaf
-    satellite full-box export next to the live tree."""
-    ids = tree.converged_leaves()
-    if not ids:
+def _fill_chunks(tree: Tree, ids: np.ndarray, out: LeafTable,
+                 chunk: int) -> None:
+    """Stream leaf payloads + barycentric inverses into preallocated
+    (possibly memory-mapped) arrays, `chunk` leaves at a time.  The only
+    live transients are one chunk's payload slices and its batched
+    inverse -- O(chunk), independent of L."""
+    for lo in range(0, ids.size, chunk):
+        sl = slice(lo, lo + chunk)
+        ids_c = ids[sl]
+        delta, U, V = tree.leaf_payloads(ids_c)
+        out.bary_M[sl] = geometry.barycentric_matrices(
+            tree.vertices[ids_c])
+        out.U[sl] = U
+        out.V[sl] = V
+        out.delta[sl] = delta.astype(np.int32)
+        out.node_id[sl] = ids_c.astype(np.int32)
+
+
+def _leaf_ids(tree: Tree) -> np.ndarray:
+    ids = tree.converged_leaf_ids()
+    if ids.size == 0:
         raise ValueError("tree has no converged leaves")
-    ids = np.asarray(ids, dtype=np.int64)
-    delta, U, V = tree.leaf_payloads(ids)
-    return LeafTable(
-        bary_M=geometry.barycentric_matrices(tree.vertices[ids]),
-        U=U, V=V, delta=delta.astype(np.int32),
-        node_id=ids.astype(np.int32))
+    return ids
+
+
+def _field_shapes(tree: Tree, L: int) -> dict[str, tuple]:
+    m = tree.p + 1
+    return {"bary_M": (L, m, m), "U": (L, m, tree.n_u), "V": (L, m),
+            "delta": (L,), "node_id": (L,)}
+
+
+def _field_dtype(name: str):
+    return np.int32 if name in ("delta", "node_id") else np.float64
+
+
+def export_leaves(tree: Tree, chunk: int = DEFAULT_CHUNK) -> LeafTable:
+    """In-RAM export, chunk-streamed into one preallocated table.  (The
+    per-leaf python loop this replaced built 3L small arrays in lists
+    and OOM'd the 9.8M-leaf satellite full-box export next to the live
+    tree; the later one-shot vectorized form still materialized the
+    full [V^T; 1] stack -- the chunked core bounds every transient.)"""
+    ids = _leaf_ids(tree)
+    shapes = _field_shapes(tree, ids.size)
+    out = LeafTable(**{k: np.empty(shapes[k], dtype=_field_dtype(k))
+                       for k in _LEAF_FIELDS})
+    _fill_chunks(tree, ids, out, chunk)
+    return out
+
+
+def write_leaf_table(tree: Tree, dir_path: str,
+                     chunk: int = DEFAULT_CHUNK) -> LeafTable:
+    """Stream the leaf table into memory-mapped ``<dir>/<field>.npy``
+    files; peak additional RSS is O(chunk), so a built tree can be
+    exported next to itself without doubling host memory.  Returns the
+    memmap-backed table (flushed; reopen with load_leaf_table for a
+    clean read-only mapping)."""
+    ids = _leaf_ids(tree)
+    os.makedirs(dir_path, exist_ok=True)
+    shapes = _field_shapes(tree, ids.size)
+    out = LeafTable(**{
+        k: np.lib.format.open_memmap(
+            os.path.join(dir_path, f"{k}.npy"), mode="w+",
+            dtype=_field_dtype(k), shape=shapes[k])
+        for k in _LEAF_FIELDS})
+    _fill_chunks(tree, ids, out, chunk)
+    for a in out:
+        a.flush()
+    with open(os.path.join(dir_path, "meta.json"), "w") as f:
+        json.dump({"n_leaves": int(ids.size), "p": tree.p,
+                   "n_u": tree.n_u}, f)
+    return out
+
+
+def save_leaf_table(table: LeafTable, dir_path: str) -> None:
+    """Persist an already-materialized table (same layout as
+    write_leaf_table; prefer that for large trees -- it never holds the
+    full table in RAM)."""
+    os.makedirs(dir_path, exist_ok=True)
+    for k in _LEAF_FIELDS:
+        np.save(os.path.join(dir_path, f"{k}.npy"), getattr(table, k))
+    with open(os.path.join(dir_path, "meta.json"), "w") as f:
+        json.dump({"n_leaves": int(table.n_leaves),
+                   "p": int(table.bary_M.shape[1] - 1),
+                   "n_u": int(table.U.shape[2])}, f)
+
+
+def load_leaf_table(dir_path: str, mmap: bool = True) -> LeafTable:
+    """Load an exported table; ``mmap=True`` maps the files read-only
+    (pages fault in on demand -- the online stage working set, not L,
+    bounds RSS), ``mmap=False`` reads full copies."""
+    mode = "r" if mmap else None
+    return LeafTable(*(np.load(os.path.join(dir_path, f"{k}.npy"),
+                               mmap_mode=mode)
+                       for k in _LEAF_FIELDS))
 
 
 def semi_explicit_mask(tree: Tree, table: LeafTable) -> np.ndarray:

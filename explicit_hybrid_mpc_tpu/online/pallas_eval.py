@@ -37,6 +37,11 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+try:  # jax >= 0.5 top-level export
+    _enable_x64 = jax.enable_x64
+except AttributeError:  # older jax: the experimental home
+    from jax.experimental import enable_x64 as _enable_x64
+
 from explicit_hybrid_mpc_tpu.online.evaluator import EvalResult
 from explicit_hybrid_mpc_tpu.online.export import LeafTable
 
@@ -149,7 +154,7 @@ def locate(ptable: PallasLeafTable, thetas: jax.Array,
     # x64 is enabled globally (the IPM needs it) but Mosaic has no i64:
     # trace the kernel with x64 off so index-map and iota constants lower
     # as i32.  Everything here is f32/i32 by construction.
-    with jax.enable_x64(False):
+    with _enable_x64(False):
         val, idx = _locate_call(grid, PV, K, th1, ptable.bary_T, interpret)
     return idx[:B, 0], val[:B, 0]
 

@@ -1,0 +1,374 @@
+"""Sharded descent serving: split the leaf/descent tables across devices.
+
+The flat descent path (online/descent.py) keeps ONE table of all nodes
+and all leaves; its us/query degrades with leaf count (0.863 us at 12k
+leaves -> 62.7 us at 9.8M, commit 0ff2285) because every level of the
+fori_loop gathers from arrays far larger than any cache, and the whole
+multi-GB table must fit one device.  This module restores near-flat
+us/query at large L by sharding:
+
+- The tree is CUT a few levels below the roots; each cut node's subtree
+  (its descent arrays AND its slice of the leaf table, both compacted to
+  shard-local ids) becomes part of one of ``n_shards`` shards, balanced
+  by leaf count (greedy largest-first).  Shards are placed round-robin
+  over devices (parallel.mesh.serving_placement) -- a shard's working
+  set is O(L / n_shards), so tables that cannot fit one device simply
+  shard wider.
+- A query is first ROUTED to its cut node: the root pick (an analytic
+  geometry.kuhn_root_locator when the root layout allows -- O(p^2) per
+  query -- else the brute min-barycentric scan as a small device
+  program, identical formula and first-max tie-break as the flat
+  locate), then ``cut_depth`` hyperplane sign tests over a routing
+  table holding only the above-cut nodes.  At the satellite full box's
+  720 roots the brute scan alone costs ~21 us/query (inside the flat
+  path's program too!) -- the analytic router is what makes serving
+  us/query nearly independent of both R and L.
+- Queries are then BATCHED PER SHARD (padded to power-of-two buckets so
+  the compiled-shape set stays bounded) and dispatched to each shard's
+  device via the shared descend_from / evaluate_rows programs; jax async
+  dispatch runs the shards concurrently and results scatter back into
+  query order.
+
+Same value contract as descent.evaluate_descent: interpolated u/cost
+equal (leaf ids may differ on shared facets, as everywhere else in the
+online stack); `leaf` is the GLOBAL leaf-table row.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from explicit_hybrid_mpc_tpu.online import descent as descent_mod
+from explicit_hybrid_mpc_tpu.online.descent import DescentTable
+from explicit_hybrid_mpc_tpu.online.evaluator import (DeviceLeafTable,
+                                                      EvalResult)
+from explicit_hybrid_mpc_tpu.online.export import LeafTable
+from explicit_hybrid_mpc_tpu.parallel.mesh import serving_placement
+from explicit_hybrid_mpc_tpu.partition.tree import NO_CHILD
+
+_MIN_BUCKET = 8
+
+
+@jax.jit
+def _serve_shard(dt: DescentTable, leaves: DeviceLeafTable,
+                 thetas: jax.Array, node0: jax.Array, tol: float
+                 ) -> tuple[jax.Array, EvalResult]:
+    """Descend + interpolate as ONE program per shard: halves the
+    per-shard dispatch count, which at tens of shards is the dominant
+    serving overhead."""
+    row, _node = descent_mod.descend_from(dt, thetas, node0)
+    return row, descent_mod.evaluate_rows(leaves, thetas, row, tol)
+
+
+def _bucket(n: int) -> int:
+    """Power-of-two padding >= n: bounds the per-shard compiled-shape
+    set to log2(max batch) programs."""
+    return max(_MIN_BUCKET, 1 << max(0, (n - 1).bit_length()))
+
+
+def _find_cut(children: np.ndarray, root_node: np.ndarray,
+              target: int) -> tuple[np.ndarray, int]:
+    """Descend level-by-level from the roots until the frontier holds at
+    least `target` nodes (leaves stay put); returns (cut node ids,
+    cut_depth).  The frontier after k steps is exactly the set of nodes
+    a k-step routing descent can land on."""
+    cur = root_node.astype(np.int64)
+    k = 0
+    while cur.size < target:
+        ch = children[cur]
+        leaf = ch[:, 0] == NO_CHILD
+        if leaf.all():
+            break
+        cur = np.concatenate([cur[leaf], ch[~leaf].reshape(-1)])
+        k += 1
+    return cur, k
+
+
+def _subtree_owners(children: np.ndarray, cut: np.ndarray) -> np.ndarray:
+    """(Nn,) index into `cut` of the owning cut node (-1 above the cut),
+    by breadth-first owner propagation."""
+    owner = np.full(children.shape[0], -1, dtype=np.int64)
+    owner[cut] = np.arange(cut.size)
+    frontier = cut
+    while frontier.size:
+        ch = children[frontier]
+        live = ch[:, 0] != NO_CHILD
+        kids = ch[live].reshape(-1)
+        owner[kids] = np.repeat(owner[frontier[live]], 2)
+        frontier = kids
+    return owner
+
+
+def _balance(counts: np.ndarray, n_shards: int) -> np.ndarray:
+    """Greedy largest-first bin packing: (n_cut,) shard per cut node."""
+    shard = np.zeros(counts.size, dtype=np.int64)
+    load = np.zeros(n_shards, dtype=np.int64)
+    for c in np.argsort(counts, kind="stable")[::-1]:
+        s = int(np.argmin(load))
+        shard[c] = s
+        load[s] += counts[c]
+    return shard
+
+
+class ShardedDescent:
+    """Descent/leaf tables sharded across devices, queries batched per
+    shard.  Build with `shard_descent` (from a host DescentTable +
+    LeafTable -- a fresh export or load_descent/load_leaf_table
+    artifacts; the pickled Tree is never needed)."""
+
+    def __init__(self, dt: DescentTable, table: LeafTable,
+                 n_shards: Optional[int] = None,
+                 devices: Optional[Sequence[jax.Device]] = None,
+                 granularity: int = 8, router=None):
+        devices = list(devices if devices is not None else jax.devices())
+        # Optional analytic root locator (geometry.kuhn_root_locator):
+        # callable(thetas (B, p)) -> (B,) GLOBAL root index.  Replaces
+        # the O(R)-per-query brute margin scan; the caller owns the
+        # claim that it matches this tree's root layout.
+        self._router = router
+        if n_shards is None:
+            n_shards = len(devices)
+        children = np.asarray(dt.children)
+        normal = np.asarray(dt.normal, dtype=np.float64)
+        offset = np.asarray(dt.offset, dtype=np.float64)
+        leaf_row = np.asarray(dt.leaf_row)
+        root_node = np.asarray(dt.root_node, dtype=np.int64)
+        self.root_bary = np.asarray(dt.root_bary, dtype=np.float64)
+        self.max_depth = int(dt.max_depth)
+        self.n_shards = n_shards
+        # Cut a few levels down: ~granularity cut nodes per shard gives
+        # the greedy packer enough pieces to balance leaf counts.
+        cut, self.cut_depth = _find_cut(children, root_node,
+                                        granularity * n_shards)
+        owner = _subtree_owners(children, cut)
+        node_ids = np.asarray(table.node_id, dtype=np.int64)
+        counts = np.bincount(owner[node_ids], minlength=cut.size)
+        cut_shard = _balance(counts, n_shards)
+
+        # Routing table: the above-cut nodes plus the cut itself, with
+        # children remapped to routing-local ids (NO_CHILD at the cut, so
+        # the host descent parks there).
+        above = np.flatnonzero(owner == -1)
+        rnodes = np.concatenate([above, cut])
+        rmap = np.full(children.shape[0], -1, dtype=np.int64)
+        rmap[rnodes] = np.arange(rnodes.size)
+        rch = children[rnodes].astype(np.int64)
+        rch[rch != NO_CHILD] = rmap[rch[rch != NO_CHILD]]
+        rch[np.isin(rnodes, cut)] = NO_CHILD
+        self._r_shard = np.full(rnodes.size, -1, dtype=np.int64)
+        self._r_shard[rmap[cut]] = cut_shard
+        self._r_start = np.full(rnodes.size, -1, dtype=np.int64)
+        self._r_root = rmap[root_node]
+        # The routing table IS a DescentTable over the above-cut nodes
+        # (leaf_row unused, max_depth = cut_depth): routing runs through
+        # the SAME locate_descent / descend_from programs as the shards,
+        # so the root-pick tie-break and descent sign convention cannot
+        # drift between routing and shard-local descent.  It must be a
+        # device program: the (B, R, p+1) root margin scan through
+        # numpy ufuncs cost ~40 us/query by itself at the satellite
+        # full box's 720 roots.
+        r_dev = devices[0]
+        self._rt = DescentTable(
+            root_bary=jax.device_put(self.root_bary, r_dev),
+            root_node=jax.device_put(self._r_root.astype(np.int32),
+                                     r_dev),
+            children=jax.device_put(rch.astype(np.int32), r_dev),
+            normal=jax.device_put(normal[rnodes], r_dev),
+            offset=jax.device_put(offset[rnodes], r_dev),
+            leaf_row=jax.device_put(
+                np.full(rnodes.size, -1, dtype=np.int32), r_dev),
+            max_depth=self.cut_depth)
+
+        # Per-shard compacted tables, each staged on its own device.
+        placement = serving_placement(n_shards, devices)
+        self.devices = placement
+        node_shard = np.where(owner >= 0, cut_shard[owner], -1)
+        row_shard = node_shard[node_ids]
+        self._shards = []
+        for s in range(n_shards):
+            nodes_s = np.flatnonzero(node_shard == s)
+            rows_s = np.flatnonzero(row_shard == s)
+            if nodes_s.size == 0:
+                self._shards.append(None)
+                continue
+            new_id = np.full(children.shape[0], -1, dtype=np.int64)
+            new_id[nodes_s] = np.arange(nodes_s.size)
+            ch_s = children[nodes_s].astype(np.int64)
+            ch_s[ch_s != NO_CHILD] = new_id[ch_s[ch_s != NO_CHILD]]
+            rowmap = np.full(table.n_leaves, -1, dtype=np.int64)
+            rowmap[rows_s] = np.arange(rows_s.size)
+            lr_s = leaf_row[nodes_s].astype(np.int64)
+            lr_s = np.where(lr_s >= 0, rowmap[lr_s], -1)
+            cut_s = cut[cut_shard == s]
+            self._r_start[rmap[cut_s]] = new_id[cut_s]
+            dev = placement[s]
+            dt_s = DescentTable(
+                # Root fields are routing-only and routing happens on the
+                # host; per-shard descent starts at explicit nodes.
+                root_bary=jax.device_put(
+                    np.zeros((1,) + self.root_bary.shape[1:]), dev),
+                root_node=jax.device_put(np.zeros(1, np.int32), dev),
+                children=jax.device_put(ch_s.astype(np.int32), dev),
+                normal=jax.device_put(normal[nodes_s], dev),
+                offset=jax.device_put(offset[nodes_s], dev),
+                leaf_row=jax.device_put(lr_s.astype(np.int32), dev),
+                max_depth=self.max_depth)
+            if rows_s.size:
+                dev_table = DeviceLeafTable(
+                    bary_M=jax.device_put(
+                        np.asarray(table.bary_M[rows_s]), dev),
+                    U=jax.device_put(np.asarray(table.U[rows_s]), dev),
+                    V=jax.device_put(np.asarray(table.V[rows_s]), dev))
+            else:
+                # A shard can cover only payload-free subtrees (fully
+                # infeasible region): keep one zero row so the
+                # evaluate_rows gather at safe=max(row, 0)=0 stays in
+                # bounds (row itself is -1 there, flagged outside).
+                m, n_u = table.bary_M.shape[1], table.U.shape[2]
+                dev_table = DeviceLeafTable(
+                    bary_M=jax.device_put(np.zeros((1, m, m)), dev),
+                    U=jax.device_put(np.zeros((1, m, n_u)), dev),
+                    V=jax.device_put(np.zeros((1, m)), dev))
+            self._shards.append({
+                "dt": dt_s, "leaves": dev_table, "device": dev,
+                "rows_global": rows_s, "nodes_global": nodes_s})
+
+    # -- host routing ------------------------------------------------------
+
+    def _route(self, thetas: np.ndarray) -> np.ndarray:
+        """(B,) routing-local cut node per query: root pick (analytic
+        router when given, else the routing table's locate_descent --
+        identical formula/tie-break to the flat locate) + cut_depth
+        hyperplane sign tests, all via the shared descent programs.
+        Queries are padded to a power-of-two bucket so the compiled
+        route-program set stays bounded."""
+        B = thetas.shape[0]
+        pad = _bucket(B)
+        if pad != B:
+            thetas = np.concatenate(
+                [thetas, np.zeros((pad - B, thetas.shape[1]))])
+        if self._router is not None:
+            ridx = np.asarray(self._router(thetas), dtype=np.int64)
+            node = self._r_root[ridx]
+            if self.cut_depth:
+                _row, node = descent_mod.descend_from(
+                    self._rt, jnp.asarray(thetas),
+                    jnp.asarray(node.astype(np.int32)))
+                node = np.asarray(node)
+        else:
+            _row, node = descent_mod.locate_descent(
+                self._rt, jnp.asarray(thetas))
+            node = np.asarray(node)
+        return node[:B].astype(np.int64)
+
+    # -- serving -----------------------------------------------------------
+
+    def _dispatch(self, thetas: np.ndarray, program) -> list[tuple]:
+        """Route, then batch per shard (power-of-two padding, shard-
+        device staging) and dispatch `program(shard, queries, start)`
+        on each; returns [(query idx, shard, outputs), ...].  All
+        shards dispatch before any result is read (jax async dispatch
+        runs them concurrently) -- the one scaffolding both evaluate
+        and locate run through."""
+        rnode = self._route(thetas)
+        shard = self._r_shard[rnode]
+        pending = []
+        for s in range(self.n_shards):
+            idx = np.flatnonzero(shard == s)
+            if idx.size == 0:
+                continue
+            sh = self._shards[s]
+            pad = _bucket(idx.size)
+            qs = np.zeros((pad, thetas.shape[1]))
+            qs[:idx.size] = thetas[idx]
+            n0 = np.zeros(pad, dtype=np.int32)
+            n0[:idx.size] = self._r_start[rnode[idx]]
+            dev = sh["device"]
+            pending.append((idx, sh, program(
+                sh, jax.device_put(qs, dev), jax.device_put(n0, dev))))
+        return pending
+
+    @staticmethod
+    def _global_rows(sh: dict, local: np.ndarray) -> np.ndarray:
+        """Shard-local leaf rows -> global table rows (-1 preserved;
+        payload-free shards have no rows to map)."""
+        glob = (sh["rows_global"][np.maximum(local, 0)]
+                if sh["rows_global"].size
+                else np.full(local.size, -1))
+        return np.where(local >= 0, glob, -1)
+
+    def evaluate(self, thetas: np.ndarray, tol: float = 1e-9
+                 ) -> EvalResult:
+        """Batched PWA evaluation, same contract as
+        descent.evaluate_descent; `leaf` is the global leaf-table row.
+        Accepts/returns host numpy (the serving boundary)."""
+        thetas = np.asarray(thetas, dtype=np.float64)
+        B = thetas.shape[0]
+        pending = self._dispatch(
+            thetas, lambda sh, qs, n0: _serve_shard(
+                sh["dt"], sh["leaves"], qs, n0, tol))
+        n_u = (int(pending[0][2][1].u.shape[1]) if pending
+               else self._shards_n_u())
+        u = np.zeros((B, n_u))
+        cost = np.zeros(B)
+        leaf = np.full(B, -1, dtype=np.int64)
+        inside = np.zeros(B, dtype=bool)
+        for idx, sh, (row, res) in pending:
+            n = idx.size
+            u[idx] = np.asarray(res.u)[:n]
+            cost[idx] = np.asarray(res.cost)[:n]
+            inside[idx] = np.asarray(res.inside)[:n]
+            leaf[idx] = self._global_rows(
+                sh, np.asarray(row)[:n].astype(np.int64))
+        return EvalResult(u=u, cost=cost, leaf=leaf, inside=inside)
+
+    def _shards_n_u(self) -> int:
+        for sh in self._shards:
+            if sh is not None:
+                return int(sh["leaves"].U.shape[2])
+        return 1
+
+    def locate(self, thetas: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+        """(global leaf-table row, global tree node id) per query; -1
+        row where the descent lands on a payload-free leaf."""
+        thetas = np.asarray(thetas, dtype=np.float64)
+        B = thetas.shape[0]
+        pending = self._dispatch(
+            thetas, lambda sh, qs, n0: descent_mod.descend_from(
+                sh["dt"], qs, n0))
+        rows = np.full(B, -1, dtype=np.int64)
+        nodes = np.full(B, -1, dtype=np.int64)
+        for idx, sh, (row, node) in pending:
+            n = idx.size
+            rows[idx] = self._global_rows(
+                sh, np.asarray(row)[:n].astype(np.int64))
+            nodes[idx] = sh["nodes_global"][
+                np.asarray(node)[:n].astype(np.int64)]
+        return rows, nodes
+
+    def shard_sizes(self) -> list[int]:
+        """Leaf count per shard (0 for empty shards) -- balance metric."""
+        return [0 if s is None else int(s["rows_global"].size)
+                for s in self._shards]
+
+
+def shard_descent(dt: DescentTable, table: LeafTable,
+                  n_shards: Optional[int] = None,
+                  devices: Optional[Sequence[jax.Device]] = None,
+                  granularity: int = 8, router=None) -> ShardedDescent:
+    """Build the sharded server from host-side descent + leaf tables.
+
+    `dt` should be a host export (descent.export_descent(..., stage=
+    False)) or descent.load_descent output; `table` an export_leaves /
+    load_leaf_table result (memmap-backed tables stream shard slices
+    straight from disk -- peak RSS is the largest shard, not L).
+    `router` (optional): analytic global-root locator, e.g.
+    geometry.kuhn_root_locator(problem.theta_lb, problem.theta_ub,
+    problem.root_splits) for engine-built trees -- replaces the
+    O(R)-per-query brute root scan."""
+    return ShardedDescent(dt, table, n_shards=n_shards, devices=devices,
+                          granularity=granularity, router=router)

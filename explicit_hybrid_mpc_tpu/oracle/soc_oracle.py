@@ -111,9 +111,19 @@ class SOCOracle(Oracle):
         # Device-failure fallback (frontier._fallback_oracle): the twin
         # must run the SAME exact SOC kernel -- a plain QP twin would
         # silently replace cone solves with the linear relaxation and
-        # certify cone-violating leaves.
+        # certify cone-violating leaves.  Solver-semantics kwargs are
+        # forwarded like the base Oracle.cpu_twin (ADVICE r5): n_iter /
+        # precision drive the LP joint-bound programs, and a twin with
+        # different settings would break the bit-compatibility contract.
+        # (rescue_iter / point_schedule are rejected by __init__ and
+        # therefore always at their defaults here.)
         return SOCOracle(problem, soc_n_iter=self._soc_n_iter,
-                         backend="cpu", points_cap=self.points_cap)
+                         backend="cpu",
+                         n_iter=self.n_iter + self.n_f32,
+                         precision=self.precision,
+                         n_f32=(self.n_f32 if self.precision == "mixed"
+                                else None),
+                         points_cap=self.points_cap)
 
     def point_feasibility(self, thetas, delta_idx):
         # The base implementation is phase-1 on the LINEAR rows: its

@@ -30,7 +30,10 @@ from typing import Optional, Sequence
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
+try:  # jax >= 0.6 top-level export
+    from jax import shard_map
+except ImportError:  # older jax: the experimental home
+    from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from explicit_hybrid_mpc_tpu.oracle.oracle import (
@@ -55,6 +58,25 @@ def make_mesh(shape: Optional[Sequence[int]] = None,
                          f"have {len(devices)}")
     arr = np.asarray(devices[:n], dtype=object).reshape(tuple(shape))
     return Mesh(arr, ("batch", "delta"))
+
+
+def serving_placement(n_shards: int,
+                      devices: Optional[Sequence[jax.Device]] = None
+                      ) -> list[jax.Device]:
+    """Round-robin device per serving shard (online/sharded.py).
+
+    Unlike the solve mesh (one SPMD program over all devices), the
+    sharded online path runs INDEPENDENT per-shard descent programs --
+    each shard's tables live wholly on one device and queries are
+    batched per shard -- so placement is plain round-robin: n_shards may
+    exceed the device count (several compacted shards per device still
+    shrink the per-program gather tables, which is where the large-L
+    us/query degradation comes from), and a 1-device host degrades to
+    "all shards on the one device" without a code path change."""
+    if n_shards < 1:
+        raise ValueError(f"n_shards must be >= 1, got {n_shards}")
+    devices = list(devices if devices is not None else jax.devices())
+    return [devices[s % len(devices)] for s in range(n_shards)]
 
 
 def _replicate_pad_deltas(prob: DeviceProblem, n_delta_shards: int

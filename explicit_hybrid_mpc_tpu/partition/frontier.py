@@ -99,7 +99,9 @@ class FrontierEngine:
         self.cfg = cfg
         self.log = log or RunLog(cfg.log_path, echo=False)
         p = problem.n_theta
-        self.tree = Tree(p=p, n_u=problem.n_u)
+        self.tree = Tree(p=p, n_u=problem.n_u,
+                         split_hyperplanes=getattr(
+                             cfg, "split_hyperplanes", True))
         self.roots = [self.tree.add_root(V) for V in
                       geometry.box_triangulation(
                           problem.theta_lb, problem.theta_ub,

@@ -91,6 +91,82 @@ def box_triangulation(lb: np.ndarray, ub: np.ndarray,
     return np.concatenate([kuhn_triangulation(lo, hi) for lo, hi in boxes])
 
 
+def _perm_rank(order: np.ndarray) -> np.ndarray:
+    """(B, p) permutation rows -> lexicographic rank (Lehmer code),
+    matching the order itertools.permutations(range(p)) yields."""
+    B, p = order.shape
+    rank = np.zeros(B, dtype=np.int64)
+    for i in range(p):
+        smaller = (order[:, i + 1:] < order[:, i:i + 1]).sum(axis=1)
+        rank += smaller * math.factorial(p - 1 - i)
+    return rank
+
+
+def kuhn_root_locator(lb: np.ndarray, ub: np.ndarray,
+                      splits: dict | None = None):
+    """O(p^2)-per-query analytic root location for box_triangulation
+    partitions: returns ``locate(thetas (B, p)) -> (B,) root index``
+    into the triangulation's simplex order.
+
+    The brute root pick (min-barycentric argmax over ALL roots) is a
+    (B, R, p+1, p+1) contraction -- at the satellite full box's 720
+    roots it costs more than the whole tree descent it routes for.  A
+    Kuhn simplex needs no scan: x lies in the sub-box found by
+    per-axis bisection of the split planes, and within it in the
+    permutation simplex given by sorting the normalized coordinates
+    DESCENDING (v_{k+1} = v_k + edge[pi[k]] e_{pi[k]}, so axes added
+    earlier carry larger normalized coordinates).  Stable descending
+    argsort reproduces the brute pick's first-max tie-break on shared
+    faces WITHIN a sub-box (the lexicographically smallest containing
+    permutation).  Queries EXACTLY ON a split plane land in the lower
+    sub-box (its t=1 face); the brute scan's pick there is decided by
+    last-ulp noise in the barycentric inverses (the true margins tie
+    at 0), so the two may name different roots -- both contain the
+    query, and interpolated values agree by facet continuity, the same
+    caveat as shared facets everywhere in the online stack.  Queries
+    OUTSIDE the box clamp to the nearest sub-box, which may differ
+    from the brute pick's best-margin root -- callers read the
+    evaluator's `inside` flag either way, exactly as with the scan.
+
+    Only valid for trees whose roots came from box_triangulation(lb,
+    ub, splits) with THESE arguments, in its simplex order.
+    """
+    lb = np.asarray(lb, dtype=np.float64)
+    ub = np.asarray(ub, dtype=np.float64)
+    p = lb.size
+    fact = math.factorial(p)
+    # Interior cut values per split axis, in box_triangulation's
+    # (sorted-axis, ascending-interval) nesting order.
+    axes = []
+    for axis, values in sorted((splits or {}).items()):
+        cuts = np.asarray([v for v in sorted(set(values))
+                           if lb[axis] < v < ub[axis]], dtype=np.float64)
+        if cuts.size:
+            axes.append((axis, cuts))
+
+    def locate(thetas: np.ndarray) -> np.ndarray:
+        thetas = np.asarray(thetas, dtype=np.float64)
+        B = thetas.shape[0]
+        box_idx = np.zeros(B, dtype=np.int64)
+        lo = np.broadcast_to(lb, thetas.shape).copy()
+        hi = np.broadcast_to(ub, thetas.shape).copy()
+        for axis, cuts in axes:
+            # side="left": a query EXACTLY ON a cut plane lands in the
+            # LOWER sub-box (on its t=1 face), which is the first
+            # containing root in triangulation order -- the same root
+            # the brute argmax's first-max tie-break picks.
+            k = np.searchsorted(cuts, thetas[:, axis], side="left")
+            box_idx = box_idx * (cuts.size + 1) + k
+            edges = np.concatenate([[lb[axis]], cuts, [ub[axis]]])
+            lo[:, axis] = edges[k]
+            hi[:, axis] = edges[k + 1]
+        t = (thetas - lo) / (hi - lo)
+        order = np.argsort(-t, axis=1, kind="stable")
+        return box_idx * fact + _perm_rank(order)
+
+    return locate
+
+
 def barycentric_matrix(V: np.ndarray) -> np.ndarray:
     """Matrix M with lambda = M @ [theta; 1] the barycentric coordinates.
 
@@ -187,6 +263,48 @@ def bisect(V: np.ndarray) -> tuple[np.ndarray, np.ndarray, int, int, np.ndarray]
     right = V.copy()
     right[i] = mid
     return left, right, i, j, mid
+
+
+def split_hyperplanes(Vs: np.ndarray, ij: np.ndarray
+                      ) -> tuple[np.ndarray, np.ndarray]:
+    """Batched split-face hyperplanes of longest-edge bisections.
+
+    Vs (N, p+1, p) parent vertex matrices, ij (N, 2) split edges.
+    Returns (w (N, p), c (N,)) with ||w||=1, oriented so w.x - c <= 0 on
+    the LEFT child (the child that keeps vertex i of edge (i, j)): the
+    hyperplane passes through the shared child face = {edge midpoint} u
+    {the p-1 unsplit vertices}, and its normal is the nullspace direction
+    of that face's spanning vectors.
+
+    This is THE hyperplane definition of the descent locate
+    (online/descent.py).  Tree.split calls it with N=1 at split time and
+    export_descent with N=all-internal-nodes as the fallback; per-row
+    results are bit-identical between the two (np.linalg.svd and the
+    einsum row reductions operate per matrix/row), which is what the
+    split-time-vs-batched parity tests pin."""
+    Vs = np.asarray(Vs, dtype=np.float64)
+    ij = np.asarray(ij, dtype=np.int64)
+    N, m, p = Vs.shape
+    ar = np.arange(N)
+    mid = 0.5 * (Vs[ar, ij[:, 0]] + Vs[ar, ij[:, 1]])          # (N, p)
+    if p == 1:
+        w = np.ones((N, 1))
+    else:
+        # Rows of each simplex not on the split edge, in stable order:
+        # the face spanning set whose nullspace is the split normal.
+        idx = np.arange(p + 1)
+        keep = ((idx[None, :] != ij[:, :1])
+                & (idx[None, :] != ij[:, 1:2]))                # (N, p+1)
+        rows = np.argsort(~keep, axis=1, kind="stable")[:, :p - 1]
+        others = np.take_along_axis(Vs, rows[:, :, None], axis=1)
+        _, _, vt = np.linalg.svd(others - mid[:, None, :])
+        w = vt[:, -1, :]                                       # (N, p)
+    c = np.einsum("np,np->n", w, mid)
+    flip = np.einsum("np,np->n", w, Vs[ar, ij[:, 0]]) > c
+    w[flip] *= -1.0
+    c[flip] *= -1.0
+    nrm = np.linalg.norm(w, axis=1)
+    return w / nrm[:, None], c / nrm
 
 
 def vertex_key(v: np.ndarray, decimals: int = 9) -> bytes:

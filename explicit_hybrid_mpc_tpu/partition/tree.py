@@ -37,6 +37,8 @@ from typing import Optional
 
 import numpy as np
 
+from explicit_hybrid_mpc_tpu.partition import geometry
+
 NO_CHILD = -1
 
 # leaf_flags bits
@@ -117,10 +119,19 @@ class Tree:
 
     _INIT_CAP = 1024
 
-    def __init__(self, p: int, n_u: int):
+    def __init__(self, p: int, n_u: int, split_hyperplanes: bool = True):
         self.p = p
         self.n_u = n_u
         self._n = 0
+        # Split-time descent hyperplanes: each split() computes its
+        # split-face normal/offset inline (one (p-1, p) nullspace solve,
+        # microseconds next to the oracle solves that caused the split),
+        # so the descent table is available at build end without the
+        # post-hoc batched-SVD pass over every internal node (1129 s at
+        # the 9.8M-leaf satellite export).  False (and trees loaded from
+        # pickles that predate the columns) fall back to that batched
+        # pass in online.descent.export_descent.
+        self._split_normals_live = bool(split_hyperplanes)
         self._alloc(self._INIT_CAP)
         self._alloc_payload(self._INIT_CAP)
         self._n_slots = 0
@@ -142,6 +153,10 @@ class Tree:
         self._split_edge = np.full((cap, 2), -1, dtype=np.int8)
         self._leaf_flags = np.zeros(cap, dtype=np.uint8)
         self._leaf_slot = np.full(cap, -1, dtype=np.int32)
+        # Split hyperplane per INTERNAL node (zeros at leaves / when
+        # _split_normals_live is False).
+        self._normal = np.zeros((cap, p), dtype=np.float64)
+        self._offset = np.zeros(cap, dtype=np.float64)
 
     def _alloc_payload(self, cap: int) -> None:
         self._pl_delta = np.zeros(cap, dtype=np.int32)
@@ -163,7 +178,7 @@ class Tree:
         new_cap, n = max(need, 2 * cap), self._n
         self._vertices = self._up(self._vertices, n, new_cap)
         for name in ("_parent", "_children", "_depth", "_split_edge",
-                     "_leaf_flags", "_leaf_slot"):
+                     "_leaf_flags", "_leaf_slot", "_normal", "_offset"):
             old = getattr(self, name)
             new = self._up(old, n, new_cap)
             new[n:] = (-1 if name in ("_parent", "_leaf_slot") else
@@ -206,6 +221,23 @@ class Tree:
         return self._split_edge[:self._n]
 
     @property
+    def split_normals(self) -> np.ndarray:
+        """(n, p) split hyperplane normals (unit, zeros at leaves)."""
+        return self._normal[:self._n]
+
+    @property
+    def split_offsets(self) -> np.ndarray:
+        """(n,) split hyperplane offsets (h(x) = w.x - c)."""
+        return self._offset[:self._n]
+
+    def split_hyperplanes_available(self) -> bool:
+        """True when every internal node carries its split-time
+        hyperplane, so online.descent.export_descent can slice the
+        columns instead of re-deriving all normals with the batched
+        post-hoc SVD pass (minutes-scale at multi-million-leaf trees)."""
+        return self._split_normals_live
+
+    @property
     def leaf_data(self) -> _LeafDataView:
         return _LeafDataView(self)
 
@@ -243,21 +275,41 @@ class Tree:
         matrix from the roots under exactly that relation
         (__getstate__/_rederive_vertices), so arbitrary child geometry
         would silently corrupt on save/load.  The midpoint rows are
-        checked here; the remaining rows are inherited by construction
-        in geometry.bisect."""
+        checked here, and the remaining rows are checked to be inherited
+        unchanged from the parent (a caller with correct midpoints but
+        perturbed inherited rows would otherwise be accepted and
+        silently corrupt on save/load -- ADVICE r5)."""
         assert self._children[node, 0] == NO_CHILD
         i, j = edge
-        mid = 0.5 * (self._vertices[node, i] + self._vertices[node, j])
+        pv = self._vertices[node]
+        mid = 0.5 * (pv[i] + pv[j])
         if not (np.array_equal(left_V[j], mid)
                 and np.array_equal(right_V[i], mid)):
             raise ValueError("split children are not the midpoint "
                              "bisection of the parent along `edge`")
+        if not (np.array_equal(np.delete(left_V, j, axis=0),
+                               np.delete(pv, j, axis=0))
+                and np.array_equal(np.delete(right_V, i, axis=0),
+                                   np.delete(pv, i, axis=0))):
+            raise ValueError("split children do not inherit the parent's "
+                             "non-split vertex rows unchanged")
         d = int(self._depth[node]) + 1
         li = self._add(left_V, node, d)
         ri = self._add(right_V, node, d)
         self._children[node, 0] = li
         self._children[node, 1] = ri
         self._split_edge[node] = edge
+        if self._split_normals_live:
+            # Split-time descent hyperplane: the bisection has the face
+            # vertices in hand right here, so the normal is one small
+            # nullspace solve now instead of a post-hoc batched SVD over
+            # every internal node at export time.  N=1 call of the SAME
+            # batched routine export_descent falls back to -> bit-
+            # identical DescentTable arrays (tests pin this).
+            w, c = geometry.split_hyperplanes(
+                pv[None], np.asarray([[i, j]], dtype=np.int64))
+            self._normal[node] = w[0]
+            self._offset[node] = c[0]
         return li, ri
 
     def set_leaf(self, node: int, data: LeafData) -> None:
@@ -334,10 +386,17 @@ class Tree:
         return np.nonzero(self._children[:n, 0] == NO_CHILD)[0].tolist()
 
     def converged_leaves(self) -> list[int]:
+        return self.converged_leaf_ids().tolist()
+
+    def converged_leaf_ids(self) -> np.ndarray:
+        """(L,) int64 payload-carrying leaf ids, ascending.  Array form
+        of converged_leaves(): the python-int list costs ~30 B/leaf in
+        object overhead, which at the 9.8M-leaf satellite export is
+        ~300 MB of pure boxing -- the streaming export slices this."""
         n = self._n
         mask = ((self._children[:n, 0] == NO_CHILD)
                 & (self._leaf_flags[:n] & _F_DATA != 0))
-        return np.nonzero(mask)[0].tolist()
+        return np.nonzero(mask)[0].astype(np.int64)
 
     def n_regions(self) -> int:
         return self._n_regions
@@ -353,8 +412,6 @@ class Tree:
         pick the containing root, then at each internal node descend into
         the child containing theta.  O(depth) barycentric tests.
         """
-        from explicit_hybrid_mpc_tpu.partition import geometry
-
         node = -1
         for r in roots:
             if geometry.contains(self._vertices[r], theta, tol):
@@ -389,6 +446,14 @@ class Tree:
             "split_edge": self._split_edge[:n],
             "leaf_flags": self._leaf_flags[:n],
             "leaf_slot": self._leaf_slot[:n],
+            # Split hyperplanes ARE serialized (unlike the vertex
+            # matrices): re-deriving them on load would re-pay the
+            # batched-SVD export pass this column exists to amortize
+            # away, and a resumed campaign would then export slowly.
+            "normal": self._normal[:n] if self._split_normals_live
+            else None,
+            "offset": self._offset[:n] if self._split_normals_live
+            else None,
             "pl_delta": self._pl_delta[:ns],
             "pl_inputs": self._pl_inputs[:ns],
             "pl_costs": self._pl_costs[:ns],
@@ -414,6 +479,11 @@ class Tree:
                          (self._leaf_flags, "leaf_flags"),
                          (self._leaf_slot, "leaf_slot")):
             dst[:n] = state[key]
+        nm = state.get("normal")
+        self._split_normals_live = nm is not None
+        if nm is not None:
+            self._normal[:n] = nm
+            self._offset[:n] = state["offset"]
         ns = state["pl_delta"].shape[0]
         self._n_slots = ns
         self._alloc_payload(max(self._INIT_CAP, ns))
@@ -467,6 +537,9 @@ class Tree:
             raise ValueError(
                 f"unsupported Tree pickle format {state['format']!r}")
         self.p, self.n_u = state["p"], state["n_u"]
+        # Pre-column pickles carry no split hyperplanes; export falls
+        # back to the batched post-hoc SVD pass.
+        self._split_normals_live = False
         verts = state["vertices"]
         n = len(verts)
         self._n = n

@@ -61,6 +61,7 @@ _SLOW = (
     "test_capture_scripts.py::",
     "test_cli.py::",
     "test_distributed.py::",
+    "test_export_scale.py::test_million_leaf_export_bounded_rss_and_wall",
     "test_post.py::",
     "test_sim.py::",
     "test_bench.py::test_bench_smoke_cpu_emits_json",

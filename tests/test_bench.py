@@ -21,6 +21,9 @@ def test_bench_smoke_cpu_emits_json():
         BENCH_DEADLINE="240",
         BENCH_BATCH="64",
         BENCH_POINTS_CAP="64",
+        BENCH_LARGE_DEPTH="6",
+        BENCH_LARGE_P="3",
+        BENCH_SHARDS="4",
     )
     out = subprocess.run([sys.executable, "bench.py"], capture_output=True,
                          text=True, timeout=300, cwd=REPO, env=env)
@@ -32,6 +35,13 @@ def test_bench_smoke_cpu_emits_json():
     assert data["platform"] == "cpu"
     assert data["vs_baseline"] is not None
     assert data["regions"] > 0
+    # Export-seconds + large-L serving fields (PR 1): regressions in the
+    # export/serving path must surface in every BENCH_*.json.
+    assert data["export_leaves_s"] >= 0
+    assert data["large_l_leaves"] == 6 * 2 ** 6
+    assert data["large_l_export_s"] >= 0
+    assert data["large_l_flat_us_per_query"] > 0
+    assert data["large_l_sharded_us_per_query"] > 0
     # Both serial baselines ship: the flat vmap-amortized estimate and the
     # measured best-first B&B stand-in (round-3 verdict item 8).
     assert data["vs_baseline_bnb"] is not None and data["vs_baseline_bnb"] > 0
@@ -65,6 +75,7 @@ def test_bench_smoke_carries_host_fields():
         BENCH_DEADLINE="180",
         BENCH_BATCH="32",
         BENCH_POINTS_CAP="32",
+        BENCH_LARGE_DEPTH="0",  # host-fields test: skip the extras
     )
     out = subprocess.run([sys.executable, "bench.py"], capture_output=True,
                          text=True, timeout=240, cwd=REPO, env=env)
@@ -149,3 +160,21 @@ def test_hold_sentinel_creates_and_releases(tmp_path, monkeypatch):
         assert os.path.exists(sent)
     finally:
         sys.path.remove(REPO)
+
+
+def test_busy_jiffies_excludes_guest_ticks():
+    """ADVICE r5: /proc/stat's user field already contains guest ticks;
+    busy accounting must subtract guest/guest_nice or VM hosts running
+    guests double-count and overstate the competing-CPU share."""
+    from bench import ContentionMonitor
+
+    # user nice system idle iowait irq softirq steal guest guest_nice
+    full = [100, 10, 50, 900, 30, 5, 5, 10, 40, 2]
+    assert ContentionMonitor._busy_jiffies(full) == 100 + 10 + 50 + 5 + 5 + 10
+    # Guest ticks excluded exactly once: adding guest load to user (as
+    # the kernel does) must not change the busy total beyond the real
+    # steal/virtualization fields.
+    no_guest = [60, 8, 50, 900, 30, 5, 5, 10]
+    assert ContentionMonitor._busy_jiffies(no_guest) == 60 + 8 + 50 + 5 + 5 + 10
+    # Short pre-2.6.24 lines (no steal/guest fields) still work.
+    assert ContentionMonitor._busy_jiffies([100, 10, 50, 900]) == 160

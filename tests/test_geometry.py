@@ -165,3 +165,92 @@ def test_barycentric_matrices_match_scalar():
         for i in range(Vs.shape[0]):
             np.testing.assert_allclose(
                 B[i], geo.barycentric_matrix(Vs[i]), rtol=1e-12, atol=1e-12)
+
+
+def test_longest_edge_einsum_matches_dot_tiebreak(rng):
+    """ADVICE r5: longest_edge computes squared lengths via np.einsum;
+    the pre-r5 code used per-pair np.dot.  Last-ulp differences between
+    the two summation paths could flip the relative-margin tie-break
+    and silently change which edge deep builds split.  Pin einsum/dot
+    selection equality over random simplices, Kuhn roots, and their
+    deep bisection orbits at every tier-1 problem dimension."""
+
+    def dot_select(V):
+        n = V.shape[0]
+        best = (-1.0, 0, 1)
+        for i in range(n):
+            for j in range(i + 1, n):
+                d = float(np.dot(V[i] - V[j], V[i] - V[j]))
+                if d > best[0] * (1.0 + 1e-12):
+                    best = (d, i, j)
+        return best[1], best[2]
+
+    for p in (1, 2, 4, 6):
+        sims = [rng.uniform(-1, 1, size=(p + 1, p)) for _ in range(20)]
+        sims += list(g.kuhn_triangulation(np.zeros(p), np.ones(p))[:6])
+        for V in sims:
+            for _ in range(30):  # bisection orbit: where ties live
+                sel = g.longest_edge(V)
+                assert sel == dot_select(V), (p, V)
+                left, _r, _i, _j, _m = g.bisect(V)
+                V = left
+
+
+def test_split_hyperplanes_batch_matches_scalar(rng):
+    """geometry.split_hyperplanes (the split-time/batched-export shared
+    routine) row-for-row against the scalar reference in
+    online.descent._split_hyperplane."""
+    from explicit_hybrid_mpc_tpu.online.descent import _split_hyperplane
+
+    for p in (1, 2, 4, 6):
+        Vs, ijs = [], []
+        for _ in range(12):
+            V = rng.uniform(-1, 1, size=(p + 1, p)) + 2 * np.eye(p + 1, p)
+            Vs.append(V)
+            ijs.append(g.longest_edge(V))
+        w, c = g.split_hyperplanes(np.stack(Vs), np.asarray(ijs))
+        for k, (V, ij) in enumerate(zip(Vs, ijs)):
+            ws, cs = _split_hyperplane(V, *ij)
+            np.testing.assert_allclose(w[k], ws, rtol=1e-12, atol=1e-12)
+            np.testing.assert_allclose(c[k], cs, rtol=1e-12, atol=1e-12)
+            # Orientation: negative side holds the kept-left vertex.
+            assert w[k] @ V[ij[0]] <= c[k] + 1e-12
+
+
+def test_kuhn_root_locator_matches_brute(rng):
+    """Analytic Kuhn root location == brute min-barycentric argmax over
+    the triangulation for every in-box query OFF the split planes (same
+    first-max tie-break, including repeated-coordinate face ties within
+    a sub-box).  Queries EXACTLY ON a split plane are a genuine exact
+    tie whose brute winner is decided by last-ulp inverse noise; there
+    the router must still name a CONTAINING root (its margin ties the
+    brute winner's at ~0), which is all value parity needs."""
+    for p, splits in ((2, None), (3, None), (2, {0: [0.25], 1: [-0.5]}),
+                      (4, {2: [0.0]})):
+        lb, ub = -np.ones(p), np.ones(p)
+        roots = g.box_triangulation(lb, ub, splits)
+        loc = g.kuhn_root_locator(lb, ub, splits)
+        M = np.stack([g.barycentric_matrix(V) for V in roots])
+        thetas = rng.uniform(lb, ub, size=(200, p))
+        # In-sub-box face ties: repeated coordinates.
+        thetas[:20, 1] = thetas[:20, 0]
+        on_plane = np.zeros(200, dtype=bool)
+        k = 20
+        for axis, values in sorted((splits or {}).items()):
+            for v in values:
+                thetas[k:k + 10, axis] = v
+                on_plane[k:k + 10] = True
+                k += 10
+        th1 = np.concatenate([thetas, np.ones((200, 1))], axis=1)
+        lam = np.einsum("rij,bj->bri", M, th1)
+        margins = np.min(lam, axis=-1)
+        brute = np.argmax(margins, axis=-1)
+        mine = loc(thetas)
+        np.testing.assert_array_equal(mine[~on_plane], brute[~on_plane])
+        # On-plane: containment within fp noise, and the margin ties
+        # the brute winner's.
+        picked = margins[np.arange(200), mine]
+        best = margins[np.arange(200), brute]
+        assert np.all(picked[on_plane] >= -1e-12)
+        np.testing.assert_allclose(picked[on_plane], best[on_plane],
+                                   atol=1e-12)

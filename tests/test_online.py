@@ -92,6 +92,15 @@ def test_descent_hybrid_partition(rng):
     table = export.export_leaves(res.tree)
     dev = evaluator.stage(table)
     dt = descent.export_descent(res.tree, res.roots, table)
+    # North-star problem parity: split-time hyperplane arrays must be
+    # bit-identical to the batched-SVD export on the pendulum too
+    # (pre-split roots, hybrid deltas).
+    dt_svd = descent.export_descent(res.tree, res.roots, table,
+                                    force_batched=True)
+    np.testing.assert_array_equal(np.asarray(dt.normal),
+                                  np.asarray(dt_svd.normal))
+    np.testing.assert_array_equal(np.asarray(dt.offset),
+                                  np.asarray(dt_svd.offset))
     thetas = rng.uniform(prob.theta_lb, prob.theta_ub, size=(64, 2))
     brute = evaluator.evaluate(dev, jnp.asarray(thetas))
     desc = descent.evaluate_descent(dt, dev, jnp.asarray(thetas))
@@ -112,6 +121,85 @@ def test_controller_is_continuous_across_facets(built, rng):
         pair = jnp.asarray(np.stack([th, th + eps_step]))
         out = evaluator.evaluate(dev, pair)
         assert abs(float(out.u[0, 0]) - float(out.u[1, 0])) < 1e-4
+
+
+def test_split_time_hyperplanes_match_batched_svd(built):
+    """Tentpole parity: a build with split-time hyperplanes (the
+    default) must export a DescentTable BIT-IDENTICAL to the batched
+    post-hoc SVD pass it amortizes away."""
+    prob, res, table = built
+    assert res.tree.split_hyperplanes_available()
+    dt_fast = descent.export_descent(res.tree, res.roots, table)
+    dt_slow = descent.export_descent(res.tree, res.roots, table,
+                                     force_batched=True)
+    assert dt_fast.max_depth == dt_slow.max_depth
+    for name in ("root_bary", "root_node", "children", "normal",
+                 "offset", "leaf_row"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(dt_fast, name)),
+            np.asarray(getattr(dt_slow, name)), err_msg=name)
+
+
+def test_split_hyperplanes_survive_pickle(built, tmp_path):
+    """Serialized trees keep their split-time hyperplane columns (a
+    resumed campaign must not silently fall back to the slow export),
+    and loaded-tree exports stay bit-identical to the live tree's."""
+    from explicit_hybrid_mpc_tpu.partition.tree import Tree
+
+    prob, res, table = built
+    path = str(tmp_path / "t.pkl")
+    res.tree.save(path)
+    loaded = Tree.load(path)
+    assert loaded.split_hyperplanes_available()
+    np.testing.assert_array_equal(loaded.split_normals,
+                                  res.tree.split_normals)
+    np.testing.assert_array_equal(loaded.split_offsets,
+                                  res.tree.split_offsets)
+
+
+def test_chunked_export_matches_in_ram(built, tmp_path):
+    """Streamed memmap export == in-RAM export bit-for-bit, at a chunk
+    size that forces many partial chunks; load_leaf_table round-trips
+    both mmap'd and copied."""
+    prob, res, table = built
+    d = str(tmp_path / "leaves")
+    written = export.write_leaf_table(res.tree, d, chunk=37)
+    for mmap in (True, False):
+        loaded = export.load_leaf_table(d, mmap=mmap)
+        for k in export._LEAF_FIELDS:
+            np.testing.assert_array_equal(getattr(table, k),
+                                          getattr(loaded, k), err_msg=k)
+    assert written.n_leaves == table.n_leaves
+    # A memmap-backed table serves the evaluator unchanged.
+    dev = evaluator.stage(export.load_leaf_table(d))
+    out = evaluator.evaluate(dev, jnp.asarray([[0.1, -0.2]]))
+    ref = evaluator.evaluate(evaluator.stage(table),
+                             jnp.asarray([[0.1, -0.2]]))
+    np.testing.assert_array_equal(np.asarray(out.u), np.asarray(ref.u))
+
+
+def test_descent_table_save_load(built, tmp_path):
+    """save_descent/load_descent round-trip: with the leaf-table files,
+    the online stage deploys from flat arrays alone -- no pickled
+    Tree."""
+    import os
+
+    prob, res, table = built
+    dt = descent.export_descent(res.tree, res.roots, table)
+    path = os.path.join(str(tmp_path), "dt.npz")
+    descent.save_descent(dt, path)
+    dt2 = descent.load_descent(path)
+    assert dt2.max_depth == dt.max_depth
+    for name in ("root_bary", "root_node", "children", "normal",
+                 "offset", "leaf_row"):
+        np.testing.assert_array_equal(np.asarray(getattr(dt, name)),
+                                      np.asarray(getattr(dt2, name)),
+                                      err_msg=name)
+    dev = evaluator.stage(table)
+    qs = jnp.asarray([[0.3, 0.4], [-0.5, 0.2]])
+    a = descent.evaluate_descent(dt, dev, qs)
+    b = descent.evaluate_descent(dt2, dev, qs)
+    np.testing.assert_array_equal(np.asarray(a.u), np.asarray(b.u))
 
 
 def test_tree_roots_survive_pickle(built, tmp_path):

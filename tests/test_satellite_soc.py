@@ -181,3 +181,21 @@ def test_soc_oracle_rejects_serial_and_mesh():
     with _pytest.raises(NotImplementedError, match="QP-scope"):
         SOCOracle(prob, backend="cpu").point_feasibility(
             prob.theta_lb[None], [0])
+
+
+def test_soc_cpu_twin_mirrors_solver_settings(soc_problem):
+    """ADVICE r5: the device-failure fallback twin must carry the SAME
+    solver semantics as the main oracle -- n_iter drives the LP
+    joint-bound programs, and a twin with the default schedule would
+    break the bit-compatibility contract of Oracle.cpu_twin."""
+    from explicit_hybrid_mpc_tpu.oracle.soc_oracle import SOCOracle
+
+    o = SOCOracle(soc_problem, soc_n_iter=41, backend="cpu", n_iter=22,
+                  points_cap=64)
+    twin = o.cpu_twin(soc_problem)
+    assert isinstance(twin, SOCOracle)
+    assert twin._soc_n_iter == o._soc_n_iter == 41
+    assert twin.n_iter + twin.n_f32 == o.n_iter + o.n_f32 == 22
+    assert twin.precision == o.precision
+    assert twin.points_cap == o.points_cap == 64
+    assert twin.backend == "cpu"

@@ -1,0 +1,142 @@
+"""Sharded descent serving (online/sharded.py + parallel.mesh
+serving_placement): value parity with the flat descent path, routing
+parity, shard balance, and artifact-only (tree-free) construction."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from explicit_hybrid_mpc_tpu.config import PartitionConfig
+from explicit_hybrid_mpc_tpu.online import descent, evaluator, export, sharded
+from explicit_hybrid_mpc_tpu.parallel.mesh import serving_placement
+from explicit_hybrid_mpc_tpu.partition import geometry
+from explicit_hybrid_mpc_tpu.partition.frontier import build_partition
+from explicit_hybrid_mpc_tpu.partition.synthetic import build_synthetic_tree
+from explicit_hybrid_mpc_tpu.problems.registry import make
+
+
+@pytest.fixture(scope="module")
+def built():
+    prob = make("double_integrator", N=3, theta_box=1.5)
+    cfg = PartitionConfig(problem="double_integrator", eps_a=0.5,
+                          backend="cpu", batch_simplices=64, max_depth=20)
+    res = build_partition(prob, cfg)
+    table = export.export_leaves(res.tree)
+    dt = descent.export_descent(res.tree, res.roots, table, stage=False)
+    return prob, res, table, dt
+
+
+def test_serving_placement_round_robin():
+    devs = jax.devices()
+    pl = serving_placement(2 * len(devs))
+    assert len(pl) == 2 * len(devs)
+    assert pl[: len(devs)] == devs and pl[len(devs):] == devs
+    with pytest.raises(ValueError):
+        serving_placement(0)
+
+
+def test_sharded_matches_flat_descent(built, rng):
+    prob, res, table, dt = built
+    srv = sharded.shard_descent(dt, table, n_shards=4)
+    thetas = rng.uniform(prob.theta_lb, prob.theta_ub, size=(257, 2))
+    flat = descent.evaluate_descent(
+        jax.tree_util.tree_map(jnp.asarray, dt), evaluator.stage(table),
+        jnp.asarray(thetas))
+    out = srv.evaluate(thetas)
+    np.testing.assert_array_equal(out.inside, np.asarray(flat.inside))
+    ok = out.inside
+    assert ok.all()
+    np.testing.assert_allclose(out.u[ok], np.asarray(flat.u)[ok],
+                               atol=1e-8)
+    np.testing.assert_allclose(out.cost[ok], np.asarray(flat.cost)[ok],
+                               atol=1e-8)
+    # Row routing parity, not just values (this partition has no
+    # degenerate shared-facet ambiguity at the sampled points).
+    rows, nodes = srv.locate(thetas)
+    frow, fnode = descent.locate_descent(
+        jax.tree_util.tree_map(jnp.asarray, dt), jnp.asarray(thetas))
+    np.testing.assert_array_equal(rows, np.asarray(frow))
+    np.testing.assert_array_equal(nodes, np.asarray(fnode))
+    # Leaf ids are GLOBAL table rows: payload lookups must agree.
+    np.testing.assert_array_equal(table.node_id[rows],
+                                  np.asarray(fnode))
+
+
+def test_sharded_outside_flagged(built):
+    prob, res, table, dt = built
+    srv = sharded.shard_descent(dt, table, n_shards=4)
+    out = srv.evaluate(np.asarray([[10.0, 10.0]]))
+    assert not bool(out.inside[0])
+
+
+def test_shards_are_balanced_and_cover(built):
+    prob, res, table, dt = built
+    srv = sharded.shard_descent(dt, table, n_shards=4)
+    sizes = srv.shard_sizes()
+    assert sum(sizes) == table.n_leaves
+    assert max(sizes) <= 2 * max(1, table.n_leaves // 4)
+
+
+def test_sharded_from_saved_artifacts(built, tmp_path, rng):
+    """The serving path needs only the exported artifacts -- leaf-table
+    .npy files (memmap'd) + descent .npz -- never the pickled Tree."""
+    import os
+
+    prob, res, table, dt = built
+    d = str(tmp_path / "leaves")
+    export.write_leaf_table(res.tree, d)
+    descent.save_descent(
+        descent.export_descent(res.tree, res.roots, table),
+        os.path.join(d, "dt.npz"))
+    t2 = export.load_leaf_table(d)
+    dt2 = descent.load_descent(os.path.join(d, "dt.npz"))
+    srv = sharded.shard_descent(dt2, t2, n_shards=3)
+    thetas = rng.uniform(prob.theta_lb, prob.theta_ub, size=(64, 2))
+    ref = sharded.shard_descent(dt, table, n_shards=3).evaluate(thetas)
+    out = srv.evaluate(thetas)
+    np.testing.assert_array_equal(out.u, ref.u)
+    np.testing.assert_array_equal(out.leaf, ref.leaf)
+
+
+def test_sharded_with_kuhn_router(rng):
+    """Analytic root routing on a synthetic box tree: same rows as the
+    brute-scan server, values matching the flat path."""
+    tree, roots = build_synthetic_tree(p=3, depth=6, n_u=2)
+    table = export.export_leaves(tree)
+    dt = descent.export_descent(tree, roots, table, stage=False)
+    router = geometry.kuhn_root_locator(np.zeros(3), np.ones(3))
+    thetas = rng.uniform(0.0, 1.0, size=(300, 3))
+    srv_scan = sharded.shard_descent(dt, table, n_shards=5)
+    srv_router = sharded.shard_descent(dt, table, n_shards=5,
+                                       router=router)
+    a, b = srv_scan.evaluate(thetas), srv_router.evaluate(thetas)
+    np.testing.assert_array_equal(a.leaf, b.leaf)
+    np.testing.assert_array_equal(a.u, b.u)
+    assert a.inside.all()
+    flat = descent.evaluate_descent(
+        jax.tree_util.tree_map(jnp.asarray, dt), evaluator.stage(table),
+        jnp.asarray(thetas))
+    np.testing.assert_allclose(b.u, np.asarray(flat.u), atol=1e-9)
+
+
+def test_payload_free_shard_flags_outside():
+    """A shard covering only payload-free subtrees (fully infeasible
+    region) must flag its queries outside with row -1, not crash on
+    empty leaf slices."""
+    from explicit_hybrid_mpc_tpu.partition.tree import LeafData, Tree
+
+    t = Tree(p=1, n_u=1)
+    r = t.add_root(np.array([[0.0], [1.0]]))
+    left, right, i, j, _ = geometry.bisect(t.vertices[r])
+    li, ri = t.split(r, left, right, (i, j))
+    t.set_leaf(li, LeafData(delta_idx=0, vertex_inputs=np.ones((2, 1)),
+                            vertex_costs=np.zeros(2)))
+    table = export.export_leaves(t)
+    dt = descent.export_descent(t, [r], table, stage=False)
+    srv = sharded.shard_descent(dt, table, n_shards=2, granularity=1)
+    out = srv.evaluate(np.array([[0.25], [0.75]]))
+    assert bool(out.inside[0]) and not bool(out.inside[1])
+    assert out.leaf[0] == 0 and out.leaf[1] == -1
+    rows, nodes = srv.locate(np.array([[0.75]]))
+    assert rows[0] == -1 and nodes[0] == ri

@@ -115,92 +115,23 @@ def cpu_cache_dir(base: str | None = None) -> str:
                         f"cpu-{host_cpu_fingerprint()}-d{n}")
 
 
-class ContentionMonitor:
-    """Background sampler of how much CPU OTHER processes burned while
-    the benchmark ran (r4 weak #1: a competing campaign on the one-core
-    host halved the driver-visible number and nothing recorded it).
+# ContentionMonitor's implementation moved to the obs subsystem
+# (explicit_hybrid_mpc_tpu/obs/host.py) where its readings fold into
+# the shared gauge registry.  Re-exported LAZILY (PEP 562): importing
+# the package pulls in jax, and bench's un-killable contract requires
+# every jax-adjacent import to happen inside run()'s error guard, not
+# at module import (round-1 postmortem: a hung plugin at import time
+# would ship zero numbers).  `bench.ContentionMonitor` and
+# `from bench import ContentionMonitor` both still resolve.
+def _contention_monitor_cls():
+    from explicit_hybrid_mpc_tpu.obs.host import ContentionMonitor as CM
+    return CM
 
-    Samples /proc/stat total busy jiffies against /proc/self/stat own
-    (+reaped children) jiffies; the difference over elapsed capacity is
-    the competing share.  summary() feeds the load fields of the bench
-    JSON, and a mean share above `threshold` marks the capture
-    CONTENDED in its own metric line."""
 
-    def __init__(self, interval_s: float = 2.0, threshold: float = 0.05):
-        self.interval_s = interval_s
-        self.threshold = threshold
-        self._stop = threading.Event()
-        self._samples: list[float] = []
-        self._thread: threading.Thread | None = None
-        self._load_start = None
-
-    @staticmethod
-    def _busy_jiffies(vals: list[int]) -> int:
-        """Total busy jiffies from the /proc/stat cpu-line fields
-        (user nice system idle iowait irq softirq steal guest
-        guest_nice).  idle + iowait are not busy; guest + guest_nice
-        are ALREADY counted inside user/nice (kernel accounting), so
-        they must come off too or VM hosts running guests double-count
-        and overstate the competing-CPU share (ADVICE r5)."""
-        busy = sum(vals) - vals[3] - (vals[4] if len(vals) > 4 else 0)
-        busy -= (vals[8] if len(vals) > 8 else 0)   # guest
-        busy -= (vals[9] if len(vals) > 9 else 0)   # guest_nice
-        return busy
-
-    @staticmethod
-    def _jiffies() -> tuple[int, int] | None:
-        try:
-            with open("/proc/stat") as f:
-                vals = [int(x) for x in f.readline().split()[1:]]
-            busy = ContentionMonitor._busy_jiffies(vals)
-            with open("/proc/self/stat") as f:
-                st = f.read().rsplit(")", 1)[1].split()
-            own = sum(int(x) for x in st[11:15])  # utime stime cu cs
-            return busy, own
-        except (OSError, IndexError, ValueError):
-            return None  # non-procfs host: monitor degrades to loadavg
-
-    def _run(self) -> None:
-        hz = os.sysconf("SC_CLK_TCK")
-        ncpu = os.cpu_count() or 1
-        prev, prev_t = self._jiffies(), time.time()
-        while not self._stop.wait(self.interval_s):
-            cur, now = self._jiffies(), time.time()
-            if prev is not None and cur is not None:
-                cap = (now - prev_t) * hz * ncpu
-                if cap > 0:
-                    other = (cur[0] - prev[0]) - (cur[1] - prev[1])
-                    self._samples.append(min(1.0, max(0.0, other / cap)))
-            prev, prev_t = cur, now
-
-    def start(self) -> "ContentionMonitor":
-        try:
-            self._load_start = os.getloadavg()
-        except OSError:
-            pass
-        if self._jiffies() is not None:
-            self._thread = threading.Thread(target=self._run, daemon=True)
-            self._thread.start()
-        return self
-
-    def summary(self) -> dict:
-        self._stop.set()
-        if self._thread is not None:
-            self._thread.join(timeout=2 * self.interval_s)
-        out = {"cpu_count": os.cpu_count()}
-        try:
-            out["loadavg_end"] = [round(x, 2) for x in os.getloadavg()]
-        except OSError:
-            pass
-        if self._load_start is not None:
-            out["loadavg_start"] = [round(x, 2) for x in self._load_start]
-        if self._samples:
-            mean = float(np.mean(self._samples))
-            out.update(
-                competing_cpu_frac_mean=round(mean, 3),
-                competing_cpu_frac_max=round(max(self._samples), 3),
-                contended=mean > self.threshold)
-        return out
+def __getattr__(name):
+    if name == "ContentionMonitor":
+        return _contention_monitor_cls()
+    raise AttributeError(name)
 
 
 def log(msg: str) -> None:
@@ -498,6 +429,7 @@ def run(result: dict, monitor: ContentionMonitor | None = None) -> None:
 
     import jax
 
+    from explicit_hybrid_mpc_tpu import obs as obs_lib
     from explicit_hybrid_mpc_tpu.config import PartitionConfig
     from explicit_hybrid_mpc_tpu.oracle.oracle import Oracle
     from explicit_hybrid_mpc_tpu.partition.frontier import build_partition
@@ -567,6 +499,11 @@ def run(result: dict, monitor: ContentionMonitor | None = None) -> None:
     remaining = deadline() - time.time() - 90.0  # reserve for baseline
     budget = max(60.0, min(time_budget, remaining))
     log(f"timed build (budget {budget:.0f}s, max_steps {max_steps})...")
+    # In-memory obs handle for the timed region: build + oracle +
+    # serving metrics condense into the JSON's `metrics` block below, so
+    # every BENCH_*.json carries solve-time p50/p99, IPM iteration
+    # volume, and serving latencies -- the bench trajectory's trend data.
+    build_obs = obs_lib.Obs("jsonl")
     # max_depth 56 (vs the engine default 40): the pendulum's
     # mode-boundary slivers certify by depth ~54, so the headline build
     # completes FULLY eps-certified instead of emitting best-effort
@@ -577,11 +514,12 @@ def run(result: dict, monitor: ContentionMonitor | None = None) -> None:
                           max_depth=int(os.environ.get("BENCH_MAX_DEPTH",
                                                        "56")),
                           time_budget_s=budget)
-    res = build_partition(problem, cfg, oracle=oracle)
+    res = build_partition(problem, cfg, oracle=oracle, obs=build_obs)
     stats = res.stats
     n_point = oracle.n_point_solves
     n_simplex = oracle.n_simplex_solves
     log(f"build stats: {stats}")
+    result["metrics"] = build_obs.metrics.summary()
     result.update(value=round(stats["regions_per_s"], 2),
                   regions=stats["regions"],
                   oracle_solves=stats["oracle_solves"],
@@ -643,7 +581,7 @@ def run(result: dict, monitor: ContentionMonitor | None = None) -> None:
     try:
         from explicit_hybrid_mpc_tpu.oracle.bnb import SerialBnB
 
-        bnb = SerialBnB(serial)
+        bnb = SerialBnB(serial, obs=build_obs)
         K = int(os.environ.get("BENCH_BNB_POINTS", "16"))
         rngb = np.random.default_rng(7)
         pts_b = rngb.uniform(problem.theta_lb, problem.theta_ub,
@@ -728,12 +666,15 @@ def run(result: dict, monitor: ContentionMonitor | None = None) -> None:
     # the capture window: chunked memmap export seconds, flat-descent
     # us/query, and the sharded path's us/query (online/sharded.py).
     try:
-        large_l_metrics(result)
+        large_l_metrics(result, obs=build_obs)
     except Exception as e:  # scale metric is an extra, never fatal
         log(f"large-L metric skipped: {e!r}")
+    # Refresh the condensed block: the large-L section added serving
+    # histograms (per-shard latency, routing counters) to the registry.
+    result["metrics"] = build_obs.metrics.summary()
 
 
-def large_l_metrics(result: dict) -> None:
+def large_l_metrics(result: dict, obs=None) -> None:
     """BENCH_LARGE_DEPTH (0 disables) controls the synthetic tree depth
     (leaves = p! * 2**depth over the unit box); BENCH_LARGE_P the
     parameter dimension (default 6 -- the satellite's: 720 Kuhn roots
@@ -800,7 +741,7 @@ def large_l_metrics(result: dict) -> None:
         router = geometry.kuhn_root_locator(np.zeros(tree.p),
                                             np.ones(tree.p))
         srv = sharded.shard_descent(dt, table, n_shards=n_shards,
-                                    router=router)
+                                    router=router, obs=obs)
         srv.evaluate(qs_np)  # warm the per-shard buckets
         t0 = time.perf_counter()
         for _ in range(reps):
@@ -867,7 +808,10 @@ def main() -> int:
     result: dict = {"metric": "offline regions/sec", "value": None,
                     "unit": "regions/s", "vs_baseline": None}
     release = hold_sentinel()
-    monitor = ContentionMonitor()
+    # Late-bound class (module __getattr__ is not consulted for bare
+    # globals inside functions): the jax-importing package loads only
+    # here, inside the guard.
+    monitor = _contention_monitor_cls()()
     try:
         run(result, monitor)
     except BaseException as e:

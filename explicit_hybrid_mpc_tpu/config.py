@@ -129,11 +129,23 @@ class PartitionConfig:
     # at the 9.8M-leaf satellite).  False exists for the parity tests
     # and for measuring the amortized cost itself.
     split_hyperplanes: bool = True
+    # Observability (explicit_hybrid_mpc_tpu/obs/): 'off' = every hook a
+    # shared no-op; 'jsonl' = spans/events/metric snapshots stream to
+    # obs_path (in-memory only when obs_path is None); 'full' = jsonl
+    # plus jax.profiler.TraceAnnotation passthrough on host spans, so a
+    # --profile trace shows the frontier's host regions aligned with the
+    # device programs they dispatched.  Distinct from log_path (the
+    # legacy flat per-step RunLog stream, kept for existing consumers).
+    obs: str = "off"
+    obs_path: Optional[str] = None
     seed: int = 0
 
     def __post_init__(self) -> None:
         if self.algorithm not in ("suboptimal", "feasible"):
             raise ValueError(f"unknown algorithm {self.algorithm!r}")
+        if self.obs not in ("off", "jsonl", "full"):
+            raise ValueError(f"unknown obs mode {self.obs!r} "
+                             "(expected 'off', 'jsonl', or 'full')")
         if self.eps_a <= 0 and self.eps_r <= 0 and self.algorithm == "suboptimal":
             raise ValueError("suboptimal variant needs eps_a > 0 or eps_r > 0")
         if (self.semi_explicit_boundary_depth is not None

@@ -73,6 +73,16 @@ def build_parser() -> argparse.ArgumentParser:
                    help="write a jax.profiler trace of the first "
                         "--profile-steps frontier steps to DIR")
     p.add_argument("--profile-steps", type=int, default=5)
+    p.add_argument("--obs", choices=("off", "jsonl", "full"),
+                   default="off",
+                   help="observability mode (obs subsystem): 'jsonl' "
+                        "streams spans/metrics to PREFIX.obs.jsonl; "
+                        "'full' additionally annotates host spans into "
+                        "any active jax.profiler trace "
+                        "(scripts/obs_report.py renders the stream)")
+    p.add_argument("--obs-path", metavar="FILE", default=None,
+                   help="override the obs stream path "
+                        "(default PREFIX.obs.jsonl)")
     p.add_argument("--list", action="store_true",
                    help="list registered problems and exit")
     return p
@@ -145,7 +155,10 @@ def main(argv: list[str] | None = None) -> int:
         checkpoint_path=(f"{prefix}.ckpt.pkl"
                          if args.checkpoint_every else None),
         log_path=f"{prefix}.log.jsonl", precision=args.precision,
-        profile_path=args.profile, profile_steps=args.profile_steps)
+        profile_path=args.profile, profile_steps=args.profile_steps,
+        obs=args.obs,
+        obs_path=(args.obs_path or f"{prefix}.obs.jsonl"
+                  if args.obs != "off" else None))
 
     if snapshot is not None:
         # SOLVER flags (precision/backend/eps/batch...) come from the
@@ -181,13 +194,17 @@ def main(argv: list[str] | None = None) -> int:
             if cli_v != snap_v:
                 print(f"resume: using snapshot {fld}={snap_v!r} "
                       f"(CLI value {cli_v!r} ignored)", file=sys.stderr)
+        # Obs knobs stay with THIS run (output-class flags, like the
+        # log/profile paths; snapshots predating the knobs resolve
+        # through the dataclass's class-level defaults).
         cfg = dataclasses.replace(
             snap_cfg, log_path=cfg.log_path,
             max_steps=cfg.max_steps,
             checkpoint_every=cfg.checkpoint_every,
             checkpoint_path=cfg.checkpoint_path,
             profile_path=cfg.profile_path,
-            profile_steps=cfg.profile_steps)
+            profile_steps=cfg.profile_steps,
+            obs=cfg.obs, obs_path=cfg.obs_path)
 
     # Built from the FINAL cfg: on resume that is the snapshot's problem +
     # constructor args, so matrix shapes always match the restored cache.

@@ -1,0 +1,106 @@
+"""Thread-safe in-memory + JSONL record sink (the obs transport).
+
+Every record is one JSON object carrying the versioned common envelope
+-- `t` (seconds since the sink's epoch), `kind` ("span" | "event" |
+"metrics" | "meta") and `name` -- plus flat producer fields.  numpy
+scalars/arrays are coerced by the encoder's `default=` hook: build
+stats carry np.float32/np.int64 fields and the bare json.dumps used to
+raise TypeError mid-run (tests/test_obs.py pins the regression).  The
+full schema is documented in docs/observability.md.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import threading
+import time
+from typing import IO, Optional
+
+import numpy as np
+
+# Bumped whenever the record envelope or a producer's field layout
+# changes incompatibly; the sink stamps it into the stream's leading
+# `meta`/`schema` record and readers (scripts/obs_report.py) check it.
+SCHEMA_VERSION = 1
+
+
+def json_default(o):
+    """`json.dumps(default=...)` hook: numpy scalars become Python
+    scalars, arrays become lists, and anything else degrades to repr --
+    a record must never fail to serialize (observability crashing the
+    instrumented run is the worst possible trade)."""
+    if isinstance(o, np.generic):
+        return o.item()
+    if isinstance(o, np.ndarray):
+        return o.tolist()
+    return repr(o)
+
+
+class JsonlSink:
+    """Append-only record sink: in-memory list + optional JSONL file.
+
+    Thread-safe: the build loop, the serving path, and background
+    samplers (obs.host.ContentionMonitor) may emit concurrently.
+    Context manager so the file handle closes on exceptions (the old
+    RunLog leaked its handle on any raise between open and close --
+    satellite fix, PR 2)."""
+
+    def __init__(self, path: Optional[str] = None, echo: bool = False,
+                 base_t: float = 0.0, keep: bool = True,
+                 max_records: int = 500_000,
+                 schema_meta: bool = False):
+        """base_t: cumulative elapsed seconds from PREVIOUS sessions of
+        a resumed run, so the `t` column stays monotonic across an
+        append boundary (see utils.logging.RunLog).  keep=False skips
+        the in-memory list (multi-hour JSONL streams are millions of
+        lines; file-only consumers never read it).  max_records bounds
+        the in-memory list -- the FILE stream keeps everything, only
+        the memory copy stops growing (n_dropped counts the overflow)."""
+        self._lock = threading.Lock()
+        self._fh: Optional[IO[str]] = open(path, "a") if path else None
+        self.path = path
+        self._echo = echo
+        self._keep = keep
+        self._max_records = max_records
+        self.records: list[dict] = []
+        self.n_dropped = 0
+        self.t0 = time.perf_counter() - base_t
+        if schema_meta:
+            self.emit("meta", "schema", version=SCHEMA_VERSION)
+
+    def emit(self, kind: str, name: str, **fields) -> dict:
+        rec = {"t": round(time.perf_counter() - self.t0, 6),
+               "kind": kind, "name": name, **fields}
+        line = json.dumps(rec, default=json_default)
+        with self._lock:
+            if self._keep:
+                if len(self.records) < self._max_records:
+                    self.records.append(rec)
+                else:
+                    self.n_dropped += 1
+            if self._fh:
+                self._fh.write(line + "\n")
+                self._fh.flush()
+        if self._echo:
+            print(line, file=sys.stderr)
+        return rec
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fh:
+                self._fh.close()
+                self._fh = None
+
+    def __enter__(self) -> "JsonlSink":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def load_jsonl(path: str) -> list[dict]:
+    """Parse a JSONL stream back into records (shared by
+    scripts/obs_report.py, post-processing, and the schema tests)."""
+    with open(path) as f:
+        return [json.loads(ln) for ln in f if ln.strip()]

@@ -29,6 +29,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from explicit_hybrid_mpc_tpu import obs as obs_lib
 from explicit_hybrid_mpc_tpu.online.evaluator import (DeviceLeafTable,
                                                       EvalResult)
 from explicit_hybrid_mpc_tpu.online.export import LeafTable
@@ -85,7 +86,8 @@ def _split_hyperplane(V: np.ndarray, i: int, j: int
 
 def export_descent(tree: Tree, roots: list[int], table: LeafTable,
                    force_batched: bool = False,
-                   stage: bool = True) -> DescentTable:
+                   stage: bool = True,
+                   obs: "obs_lib.Obs | None" = None) -> DescentTable:
     """Flatten a built tree into descent arrays (host, then staged).
 
     Trees built with split-time hyperplanes (partition.tree.Tree.split,
@@ -100,40 +102,44 @@ def export_descent(tree: Tree, roots: list[int], table: LeafTable,
     for the split-time-vs-batched parity cross-check.
     `_split_hyperplane` stays as the scalar reference the tests check
     the batch against."""
-    Nn = len(tree)
-    p = tree.p
-    children = np.asarray(tree.children, dtype=np.int32)
-    use_stored = tree.split_hyperplanes_available() and not force_batched
-    if use_stored:
-        normal = np.array(tree.split_normals, dtype=np.float64)
-        offset = np.array(tree.split_offsets, dtype=np.float64)
-    else:
-        normal = np.zeros((Nn, p))
-        offset = np.zeros(Nn)
-        internal = np.flatnonzero(children[:, 0] != NO_CHILD)
-        if internal.size:
-            w, c = geometry.split_hyperplanes(
-                np.asarray(tree.vertices[internal]),
-                np.asarray(tree.split_edge[internal], dtype=np.int64))
-            normal[internal] = w
-            offset[internal] = c
-    leaf_row = np.full(Nn, -1, dtype=np.int32)
-    leaf_row[table.node_id] = np.arange(table.n_leaves, dtype=np.int32)
-    root_bary = geometry.barycentric_matrices(
-        tree.vertices[np.asarray(roots, dtype=np.int64)])
-    # stage=False keeps host numpy arrays: the sharded serving path
-    # (online/sharded.py) slices per-shard tables out of them and stages
-    # each slice on ITS OWN device -- staging the full table on the
-    # default device first would defeat the point.
-    lift = jnp.asarray if stage else np.asarray
-    return DescentTable(
-        root_bary=lift(root_bary),
-        root_node=lift(np.asarray(roots, dtype=np.int32)),
-        children=lift(children),
-        normal=lift(normal),
-        offset=lift(offset),
-        leaf_row=lift(leaf_row),
-        max_depth=int(tree.max_depth()))
+    o = obs if obs is not None else obs_lib.default()
+    with o.span("export.descent", nodes=len(tree),
+                leaves=int(table.n_leaves)) as sp:
+        Nn = len(tree)
+        p = tree.p
+        children = np.asarray(tree.children, dtype=np.int32)
+        use_stored = tree.split_hyperplanes_available() and not force_batched
+        sp["stored_hyperplanes"] = bool(use_stored)
+        if use_stored:
+            normal = np.array(tree.split_normals, dtype=np.float64)
+            offset = np.array(tree.split_offsets, dtype=np.float64)
+        else:
+            normal = np.zeros((Nn, p))
+            offset = np.zeros(Nn)
+            internal = np.flatnonzero(children[:, 0] != NO_CHILD)
+            if internal.size:
+                w, c = geometry.split_hyperplanes(
+                    np.asarray(tree.vertices[internal]),
+                    np.asarray(tree.split_edge[internal], dtype=np.int64))
+                normal[internal] = w
+                offset[internal] = c
+        leaf_row = np.full(Nn, -1, dtype=np.int32)
+        leaf_row[table.node_id] = np.arange(table.n_leaves, dtype=np.int32)
+        root_bary = geometry.barycentric_matrices(
+            tree.vertices[np.asarray(roots, dtype=np.int64)])
+        # stage=False keeps host numpy arrays: the sharded serving path
+        # (online/sharded.py) slices per-shard tables out of them and
+        # stages each slice on ITS OWN device -- staging the full table
+        # on the default device first would defeat the point.
+        lift = jnp.asarray if stage else np.asarray
+        return DescentTable(
+            root_bary=lift(root_bary),
+            root_node=lift(np.asarray(roots, dtype=np.int32)),
+            children=lift(children),
+            normal=lift(normal),
+            offset=lift(offset),
+            leaf_row=lift(leaf_row),
+            max_depth=int(tree.max_depth()))
 
 
 @functools.partial(jax.jit, static_argnames=())

@@ -27,6 +27,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from explicit_hybrid_mpc_tpu import obs as obs_lib
 from explicit_hybrid_mpc_tpu.online.export import LeafTable
 
 
@@ -43,10 +44,16 @@ class DeviceLeafTable(NamedTuple):
     V: jax.Array
 
 
-def stage(table: LeafTable) -> DeviceLeafTable:
-    return DeviceLeafTable(bary_M=jnp.asarray(table.bary_M),
-                           U=jnp.asarray(table.U),
-                           V=jnp.asarray(table.V))
+def stage(table: LeafTable,
+          obs: "obs_lib.Obs | None" = None) -> DeviceLeafTable:
+    """Host leaf table -> device arrays.  The staging span makes the
+    one-time host->device transfer cost visible at large L (a multi-GB
+    table's device_put is seconds, easily mistaken for serving cost)."""
+    o = obs if obs is not None else obs_lib.default()
+    with o.span("serve.stage_leaves", leaves=int(table.n_leaves)):
+        return DeviceLeafTable(bary_M=jnp.asarray(table.bary_M),
+                               U=jnp.asarray(table.U),
+                               V=jnp.asarray(table.V))
 
 
 @functools.partial(jax.jit, static_argnames=())

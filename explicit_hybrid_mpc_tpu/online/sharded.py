@@ -36,12 +36,14 @@ online stack); `leaf` is the GLOBAL leaf-table row.
 
 from __future__ import annotations
 
+import time
 from typing import Optional, Sequence
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from explicit_hybrid_mpc_tpu import obs as obs_lib
 from explicit_hybrid_mpc_tpu.online import descent as descent_mod
 from explicit_hybrid_mpc_tpu.online.descent import DescentTable
 from explicit_hybrid_mpc_tpu.online.evaluator import (DeviceLeafTable,
@@ -51,6 +53,10 @@ from explicit_hybrid_mpc_tpu.parallel.mesh import serving_placement
 from explicit_hybrid_mpc_tpu.partition.tree import NO_CHILD
 
 _MIN_BUCKET = 8
+
+# Batch-size histogram bounds: power-of-two edges matching the padding
+# buckets, so the distribution reads directly as compiled-shape usage.
+_BATCH_BOUNDS = tuple(float(1 << k) for k in range(21))
 
 
 @jax.jit
@@ -123,8 +129,14 @@ class ShardedDescent:
     def __init__(self, dt: DescentTable, table: LeafTable,
                  n_shards: Optional[int] = None,
                  devices: Optional[Sequence[jax.Device]] = None,
-                 granularity: int = 8, router=None):
+                 granularity: int = 8, router=None,
+                 obs: "obs_lib.Obs | None" = None):
         devices = list(devices if devices is not None else jax.devices())
+        # Serving observability (obs subsystem): per-shard query-latency
+        # histograms, batch sizes, routing counters, imbalance gauge.
+        # NOOP by default -- the hot path pays one boolean test per
+        # batch when disabled.
+        self._obs = obs if obs is not None else obs_lib.NOOP
         # Optional analytic root locator (geometry.kuhn_root_locator):
         # callable(thetas (B, p)) -> (B,) GLOBAL root index.  Replaces
         # the O(R)-per-query brute margin scan; the caller owns the
@@ -234,8 +246,42 @@ class ShardedDescent:
                     U=jax.device_put(np.zeros((1, m, n_u)), dev),
                     V=jax.device_put(np.zeros((1, m)), dev))
             self._shards.append({
-                "dt": dt_s, "leaves": dev_table, "device": dev,
+                "sid": s, "dt": dt_s, "leaves": dev_table, "device": dev,
                 "rows_global": rows_s, "nodes_global": nodes_s})
+        # Metric objects are resolved ONCE here (registry lookups are
+        # lock-guarded and the serving loop is the us/query hot path);
+        # None when disabled, so the hot path pays one truthiness test.
+        self._ms = None
+        if self._obs.enabled:
+            sizes = self.shard_sizes()
+            mean = sum(sizes) / max(1, len(sizes))
+            m = self._obs.metrics
+            m.gauge("serve.shards").set(self.n_shards)
+            m.gauge("serve.leaves").set(float(sum(sizes)))
+            m.gauge("serve.cut_depth").set(self.cut_depth)
+            # Greedy-packing quality: max/mean leaf load (1.0 = perfect).
+            m.gauge("serve.shard_imbalance").set(
+                max(sizes) / mean if mean else 0.0)
+            self._obs.event("serve.sharded", shards=self.n_shards,
+                            cut_depth=self.cut_depth, sizes=sizes)
+            self._ms = {
+                "shard_hist": {
+                    sh["sid"]: m.histogram(
+                        f"serve.shard{sh['sid']:02d}.query_s")
+                    for sh in self._shards if sh is not None},
+                "batch": m.histogram("serve.shard_batch",
+                                     bounds=_BATCH_BOUNDS),
+                "route_s": m.histogram("serve.route_s"),
+                # Analytic-vs-brute root pick: the O(R) brute scan is
+                # the large-R serving bottleneck (docs/perf.md), so the
+                # routing mode must be visible per query count.
+                "route_q": m.counter("serve.route_analytic_queries"
+                                     if self._router is not None
+                                     else "serve.route_brute_queries"),
+                "queries": m.counter("serve.queries"),
+                "query_s": m.histogram("serve.query_s"),
+                "locate_q": m.counter("serve.locate_queries"),
+            }
 
     # -- host routing ------------------------------------------------------
 
@@ -247,6 +293,8 @@ class ShardedDescent:
         Queries are padded to a power-of-two bucket so the compiled
         route-program set stays bounded."""
         B = thetas.shape[0]
+        ms = self._ms
+        t0 = time.perf_counter() if ms else 0.0
         pad = _bucket(B)
         if pad != B:
             thetas = np.concatenate(
@@ -263,6 +311,9 @@ class ShardedDescent:
             _row, node = descent_mod.locate_descent(
                 self._rt, jnp.asarray(thetas))
             node = np.asarray(node)
+        if ms:
+            ms["route_q"].inc(B)
+            ms["route_s"].observe(time.perf_counter() - t0)
         return node[:B].astype(np.int64)
 
     # -- serving -----------------------------------------------------------
@@ -276,12 +327,15 @@ class ShardedDescent:
         and locate run through."""
         rnode = self._route(thetas)
         shard = self._r_shard[rnode]
+        ms = self._ms
         pending = []
         for s in range(self.n_shards):
             idx = np.flatnonzero(shard == s)
             if idx.size == 0:
                 continue
             sh = self._shards[s]
+            if ms:
+                ms["batch"].observe(idx.size)
             pad = _bucket(idx.size)
             qs = np.zeros((pad, thetas.shape[1]))
             qs[:idx.size] = thetas[idx]
@@ -308,6 +362,8 @@ class ShardedDescent:
         Accepts/returns host numpy (the serving boundary)."""
         thetas = np.asarray(thetas, dtype=np.float64)
         B = thetas.shape[0]
+        ms = self._ms
+        t0 = time.perf_counter() if ms else 0.0
         pending = self._dispatch(
             thetas, lambda sh, qs, n0: _serve_shard(
                 sh["dt"], sh["leaves"], qs, n0, tol))
@@ -319,11 +375,26 @@ class ShardedDescent:
         inside = np.zeros(B, dtype=bool)
         for idx, sh, (row, res) in pending:
             n = idx.size
+            # Per-shard histogram = THIS shard's own blocking consume
+            # segment per query (its program wait + transfer; the first
+            # shard consumed absorbs the async-overlapped compute).
+            # Charging whole-batch elapsed here would book routing and
+            # every earlier shard's transfer onto lightly-loaded shards
+            # as phantom per-query latency; the end-to-end amortized
+            # figure lives in serve.query_s below.
+            seg0 = time.perf_counter() if ms else 0.0
             u[idx] = np.asarray(res.u)[:n]
             cost[idx] = np.asarray(res.cost)[:n]
             inside[idx] = np.asarray(res.inside)[:n]
             leaf[idx] = self._global_rows(
                 sh, np.asarray(row)[:n].astype(np.int64))
+            if ms:
+                ms["shard_hist"][sh["sid"]].observe(
+                    (time.perf_counter() - seg0) / n, n=n)
+        if ms:
+            ms["queries"].inc(B)
+            ms["query_s"].observe(
+                (time.perf_counter() - t0) / max(B, 1), n=B)
         return EvalResult(u=u, cost=cost, leaf=leaf, inside=inside)
 
     def _shards_n_u(self) -> int:
@@ -337,6 +408,8 @@ class ShardedDescent:
         row where the descent lands on a payload-free leaf."""
         thetas = np.asarray(thetas, dtype=np.float64)
         B = thetas.shape[0]
+        if self._ms:
+            self._ms["locate_q"].inc(B)
         pending = self._dispatch(
             thetas, lambda sh, qs, n0: descent_mod.descend_from(
                 sh["dt"], qs, n0))
@@ -359,7 +432,8 @@ class ShardedDescent:
 def shard_descent(dt: DescentTable, table: LeafTable,
                   n_shards: Optional[int] = None,
                   devices: Optional[Sequence[jax.Device]] = None,
-                  granularity: int = 8, router=None) -> ShardedDescent:
+                  granularity: int = 8, router=None,
+                  obs: "obs_lib.Obs | None" = None) -> ShardedDescent:
     """Build the sharded server from host-side descent + leaf tables.
 
     `dt` should be a host export (descent.export_descent(..., stage=
@@ -371,4 +445,4 @@ def shard_descent(dt: DescentTable, table: LeafTable,
     problem.root_splits) for engine-built trees -- replaces the
     O(R)-per-query brute root scan."""
     return ShardedDescent(dt, table, n_shards=n_shards, devices=devices,
-                          granularity=granularity, router=router)
+                          granularity=granularity, router=router, obs=obs)

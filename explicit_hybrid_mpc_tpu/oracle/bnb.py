@@ -43,6 +43,8 @@ import time
 import numpy as np
 from scipy.linalg import cho_factor, cho_solve
 
+from explicit_hybrid_mpc_tpu import obs as obs_lib
+
 
 class SerialBnB:
     """Best-first enumeration with incumbent pruning, one QP at a time.
@@ -52,12 +54,16 @@ class SerialBnB:
     and the batched engine share the same solver kernel and tolerance.
     """
 
-    def __init__(self, oracle):
+    def __init__(self, oracle, obs: "obs_lib.Obs | None" = None):
         if oracle.backend != "serial":
             raise ValueError("SerialBnB requires a backend='serial' Oracle "
                              f"(got {oracle.backend!r}): the baseline's "
                              "contract is one QP per program dispatch")
         self.oracle = oracle
+        # bnb.* metrics (nodes expanded/pruned, per-point wall): the
+        # baseline's cost model becomes a continuously captured signal
+        # instead of a one-off bench printout.
+        self.obs = obs if obs is not None else obs_lib.NOOP
         can = oracle.can
         self.can = can
         # Cholesky of each commutation's (PD, problems/base.py canonical()
@@ -90,6 +96,8 @@ class SerialBnB:
         """
         import jax.numpy as jnp
 
+        t0 = time.perf_counter()
+        pruned0 = self.n_pruned
         lbs = self.root_bounds(theta)
         order = np.argsort(lbs, kind="stable")  # deterministic ties
         th_dev = jnp.asarray(theta, dtype=jnp.float64)
@@ -105,6 +113,12 @@ class SerialBnB:
             if bool(conv) and float(V) < v_best:
                 v_best, d_best = float(V), int(d)
         self.n_qp_solves += n_qp
+        if self.obs.enabled:
+            m = self.obs.metrics
+            m.counter("bnb.points").inc()
+            m.counter("bnb.nodes_expanded").inc(n_qp)
+            m.counter("bnb.nodes_pruned").inc(self.n_pruned - pruned0)
+            m.histogram("bnb.point_s").observe(time.perf_counter() - t0)
         return v_best, d_best, n_qp
 
     def measure(self, thetas: np.ndarray) -> dict:

@@ -108,6 +108,18 @@ def _make_body(Q, q, A, b):
     return body
 
 
+def schedule_iters(n_f32: int, n_f64: int) -> int:
+    """Mehrotra iterations one QP spends under an (n_f32, n_f64)
+    schedule.  The kernel is fixed-iteration by design -- no early exit
+    (see module docstring), so per-solve iteration counts are exact
+    static observables: total iterations = schedule length x solve
+    count.  This is the single definition behind the obs registry's
+    `oracle.ipm_iters` counter (Oracle._obs_batch); the counter turns
+    schedule changes (ipm_point_schedule, rescue_iter) into a visible
+    arithmetic-volume trend instead of an invisible knob."""
+    return int(n_f32) + int(n_f64)
+
+
 def qp_solve(Q: jax.Array, q: jax.Array, A: jax.Array, b: jax.Array,
              n_iter: int = 30, tol: float = 1e-8,
              n_f32: int = 0) -> QPSolution:

@@ -39,12 +39,14 @@ Backends (BASELINE.json north-star: "selectable as backend='tpu'"):
 from __future__ import annotations
 
 import functools
+import time
 from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from explicit_hybrid_mpc_tpu import obs as obs_lib
 from explicit_hybrid_mpc_tpu.oracle import ipm
 from explicit_hybrid_mpc_tpu.problems.base import CanonicalMPQP
 
@@ -284,7 +286,8 @@ class Oracle:
                  n_f32: int | None = None,
                  rescue_iter: int = 0,
                  point_schedule: tuple[int, int] | None = None,
-                 stage2_order: str = "auto"):
+                 stage2_order: str = "auto",
+                 obs: "obs_lib.Obs | None" = None):
         """mesh: optional jax.sharding.Mesh with ("batch", "delta") axes;
         when given, solve_vertices shards the (points x commutations) grid
         over it (parallel/mesh.py) instead of running on a single device --
@@ -300,6 +303,12 @@ class Oracle:
         self.problem = problem
         self.can = problem.canonical
         self.backend = backend
+        # Observability handle (obs subsystem): per-class solve-time
+        # histograms + IPM iteration counters flow through it.  NOOP by
+        # default; the frontier engine re-points it at the build's own
+        # handle (frontier.FrontierEngine.__init__) so oracle metrics
+        # land in the same registry/stream as the build's.
+        self.obs = obs if obs is not None else obs_lib.NOOP
         if precision not in ("f64", "mixed"):
             raise ValueError(f"unknown precision {precision!r}")
         self.precision = precision
@@ -479,6 +488,22 @@ class Oracle:
             rescue_iter=self.rescue_iter,
             point_schedule=self.point_schedule)
 
+    def _obs_batch(self, cls: str, n: int, wall: float,
+                   iters: int) -> None:
+        """Fold one batched device query into the metrics registry:
+        per-QP blocking-wait latency (observed with weight n so the
+        `oracle.<cls>_solve_s` histogram's quantiles stay per-solve
+        figures even though QPs solve in batches) plus the
+        `oracle.ipm_iters` counter -- the kernel is fixed-iteration by
+        design (no early exit), so iterations = schedule length x
+        solves exactly (ipm.schedule_iters)."""
+        if not self.obs.enabled or n <= 0:
+            return
+        m = self.obs.metrics
+        m.histogram(f"oracle.{cls}_solve_s").observe(wall / n, n=n)
+        m.counter(f"oracle.{cls}_solves").inc(n)
+        m.counter("oracle.ipm_iters").inc(n * iters)
+
     @staticmethod
     def _scaled_cond(H: np.ndarray) -> float:
         """Worst condition number over commutations of the Jacobi-scaled
@@ -564,6 +589,7 @@ class Oracle:
                 grad=np.zeros((0, nd, nt)), u0=np.zeros((0, nd, nu)),
                 z=np.zeros((0, nd, nz)), Vstar=np.zeros(0),
                 dstar=np.zeros(0, dtype=np.int64))
+        t0 = time.perf_counter()
         if kind == "parts":
             _, thetas, parts = handle
         else:
@@ -576,8 +602,12 @@ class Oracle:
         # Counters last: if the transfer or the rescue raised, the caller
         # reroutes the WHOLE batch to the CPU fallback, whose own counts
         # are folded in -- counting here first would double-count it.
-        self.n_solves += thetas.shape[0] * self.can.n_delta
-        self.n_point_solves += thetas.shape[0] * self.can.n_delta
+        n = thetas.shape[0] * self.can.n_delta
+        self.n_solves += n
+        self.n_point_solves += n
+        self._obs_batch("point", n, time.perf_counter() - t0,
+                        ipm.schedule_iters(self.point_n_f32,
+                                           self.point_n_iter))
         return VertexSolution(*self._finalize(parts))
 
     def _rescue_grid(self, thetas: np.ndarray, parts: list) -> None:
@@ -611,22 +641,28 @@ class Oracle:
         K = thetas.shape[0]
         self.n_solves += K
         self.n_rescue_solves += K
+        t0 = time.perf_counter()
         if self.backend == "serial":
             # Keep the serial contract (one QP per program) for rescue
             # solves too -- the serial baseline's per-solve timing must
             # not be contaminated by batched programs.
             outs = [self._rescue_one(jnp.asarray(t), int(d))
                     for t, d in zip(thetas, ds)]
-            return [np.stack([np.asarray(o[k]) for o in outs])
-                    for k in range(6)]
-        cap = self.max_pairs_per_call
-        chunks = []
-        for lo in range(0, K, cap):
-            tj, dj, Kc = self._pad_pairs(thetas[lo:lo + cap],
-                                         ds[lo:lo + cap])
-            out = self._solve_rescue(tj, dj)
-            chunks.append([np.asarray(o)[:Kc] for o in out])
-        return [np.concatenate([c[k] for c in chunks]) for k in range(6)]
+            parts = [np.stack([np.asarray(o[k]) for o in outs])
+                     for k in range(6)]
+        else:
+            cap = self.max_pairs_per_call
+            chunks = []
+            for lo in range(0, K, cap):
+                tj, dj, Kc = self._pad_pairs(thetas[lo:lo + cap],
+                                             ds[lo:lo + cap])
+                out = self._solve_rescue(tj, dj)
+                chunks.append([np.asarray(o)[:Kc] for o in out])
+            parts = [np.concatenate([c[k] for c in chunks])
+                     for k in range(6)]
+        self._obs_batch("rescue", K, time.perf_counter() - t0,
+                        ipm.schedule_iters(0, self.rescue_iter))
+        return parts
 
     def _pad_pairs(self, thetas: np.ndarray, ds: np.ndarray):
         """Pad a (point, delta) pair batch to its power-of-two bucket."""
@@ -709,6 +745,8 @@ class Oracle:
         K = bary_Ms.shape[0]
         if K == 0:
             return np.zeros(0), np.zeros(0, dtype=bool)
+        t0 = time.perf_counter()
+        n_before = self.n_solves
         cap = self.max_simplex_rows_per_call
         outs, feas_sw = [], []
         for lo in range(0, K, cap):
@@ -742,6 +780,11 @@ class Oracle:
                     feasible_somewhere[idx] = t_conv & (t <= 1e-6)
             outs.append(out)
             feas_sw.append(feasible_somewhere)
+        # n = QPs actually issued (solve-order-dependent: phase-1 rows
+        # skipped by the elastic witness, and vice versa, never ran).
+        self._obs_batch("simplex", self.n_solves - n_before,
+                        time.perf_counter() - t0,
+                        ipm.schedule_iters(self.n_f32, self.n_iter))
         return np.concatenate(outs), np.concatenate(feas_sw)
 
     def _elastic_min_into(self, Ms: np.ndarray, ds: np.ndarray,
@@ -811,7 +854,10 @@ class Oracle:
         self.n_solves += K
         self.n_simplex_solves += K
         delta_idx = np.asarray(delta_idx, dtype=np.int64)
+        t0 = time.perf_counter()
         t, conv, farkas = self._run_simplex_feas(bary_Ms, delta_idx)
+        self._obs_batch("simplex", K, time.perf_counter() - t0,
+                        ipm.schedule_iters(self.n_f32, self.n_iter))
         return t, conv & (t <= 1e-6), conv & (t > 1e-6) & farkas
 
     # -- fixed-commutation (point, delta) pair solves ----------------------
@@ -873,6 +919,7 @@ class Oracle:
             nt, nu, nz = self.can.n_theta, self.can.n_u, self.can.nz
             return (np.zeros(0), np.zeros(0, dtype=bool), np.zeros((0, nt)),
                     np.zeros((0, nu)), np.zeros((0, nz)))
+        t0 = time.perf_counter()
         if kind == "parts":
             _, thetas, delta_idx, parts = handle
         else:
@@ -891,6 +938,10 @@ class Oracle:
         # Counters last (see wait_vertices).
         self.n_solves += thetas.shape[0]
         self.n_point_solves += thetas.shape[0]
+        self._obs_batch("point", thetas.shape[0],
+                        time.perf_counter() - t0,
+                        ipm.schedule_iters(self.point_n_f32,
+                                           self.point_n_iter))
         return np.where(conv, V, _INF), conv, grad, u0, z
 
     # -- fixed-commutation point solve (the semi-explicit ONLINE stage) ----

@@ -34,6 +34,8 @@ keep the full row set -- their soundness arguments are row-global.
 
 from __future__ import annotations
 
+import time
+
 import numpy as np
 
 import jax
@@ -273,6 +275,7 @@ class PrunedOracle(Oracle):
     def wait_vertices(self, handle) -> VertexSolution:
         if handle[0] != "pruned-chunks-v":
             return super().wait_vertices(handle)
+        t0 = time.perf_counter()
         _, thetas, chunks = handle
         parts = [np.concatenate([np.asarray(out[k])[:Pc]
                                  for out, Pc, padded in chunks])
@@ -289,7 +292,21 @@ class PrunedOracle(Oracle):
         self.n_prune_fallbacks += n_fb
         self.n_solves += P * nd + n_fb + n_gate
         self.n_point_solves += P * nd + n_fb
+        self._obs_batch("point", P * nd + n_fb,
+                        time.perf_counter() - t0,
+                        ipm.schedule_iters(self.point_n_f32,
+                                           self.point_n_iter))
+        self._obs_prune(n_fb, n_gate)
         return VertexSolution(*self._finalize(parts))
+
+    def _obs_prune(self, n_fb: int, n_gate: int) -> None:
+        """Pruning-engine observables: verified-fallback re-solves (the
+        cost of each sampling miss) and phase-1 gate solves for stalled
+        reduced cells."""
+        if not self.obs.enabled:
+            return
+        self.obs.metrics.counter("oracle.prune_fallbacks").inc(n_fb)
+        self.obs.metrics.counter("oracle.prune_gate_solves").inc(n_gate)
 
     def _stalled_need_resolve(self, thetas: np.ndarray, ds: np.ndarray
                               ) -> np.ndarray:
@@ -413,6 +430,7 @@ class PrunedOracle(Oracle):
         feasible_somewhere[idx[ok]] |= conv[ok] & (t_el[ok] <= 1e-6)
         if np.any(bad):
             self.n_prune_fallbacks += int(bad.sum())
+            self._obs_prune(int(bad.sum()), 0)
             # Counter note: the full pass below counts its own solves.
             super()._elastic_min_into(Ms, ds, idx[bad], out,
                                       feasible_somewhere)
@@ -443,6 +461,7 @@ class PrunedOracle(Oracle):
     def wait_pairs(self, handle):
         if handle[0] != "pruned-chunks":
             return super().wait_pairs(handle)
+        t0 = time.perf_counter()
         _, thetas, delta_idx, chunks = handle
         parts = [np.concatenate([np.asarray(out[k])[:Kc]
                                  for out, Kc in chunks])
@@ -485,4 +504,9 @@ class PrunedOracle(Oracle):
         self.n_prune_fallbacks += n_fb
         self.n_solves += thetas.shape[0] + n_fb + n_gate
         self.n_point_solves += thetas.shape[0] + n_fb
+        self._obs_batch("point", thetas.shape[0] + n_fb,
+                        time.perf_counter() - t0,
+                        ipm.schedule_iters(self.point_n_f32,
+                                           self.point_n_iter))
+        self._obs_prune(n_fb, n_gate)
         return np.where(conv, V, _INF), conv, grad, u0, z

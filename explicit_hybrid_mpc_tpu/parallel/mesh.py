@@ -36,8 +36,15 @@ except ImportError:  # older jax: the experimental home
     from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
+from explicit_hybrid_mpc_tpu.obs.host import ContentionMonitor  # noqa: F401
 from explicit_hybrid_mpc_tpu.oracle.oracle import (
     DeviceProblem, _solve_points_grid, reduce_deltas)
+
+# ContentionMonitor is re-exported here (its implementation moved to
+# obs/host.py with the obs subsystem): it samples the HOST the mesh's
+# devices share, and its summary() folds the competing-CPU share into
+# the same gauge registry as the mesh-sharded solve metrics.  bench.py
+# re-exports it too for its original import path.
 
 
 def make_mesh(shape: Optional[Sequence[int]] = None,

@@ -33,6 +33,7 @@ import time
 
 import numpy as np
 
+from explicit_hybrid_mpc_tpu import obs as obs_lib
 from explicit_hybrid_mpc_tpu.config import PartitionConfig
 from explicit_hybrid_mpc_tpu.oracle.oracle import Oracle, VertexSolution
 from explicit_hybrid_mpc_tpu.partition import certify, geometry
@@ -93,11 +94,25 @@ class PartitionResult:
 
 class FrontierEngine:
     def __init__(self, problem, oracle: Oracle, cfg: PartitionConfig,
-                 log: RunLog | None = None):
+                 log: RunLog | None = None,
+                 obs: "obs_lib.Obs | None" = None):
         self.problem = problem
         self.oracle = oracle
         self.cfg = cfg
         self.log = log or RunLog(cfg.log_path, echo=False)
+        # Unified tracing/metrics (obs subsystem): caller-provided handle
+        # wins; otherwise built from cfg.obs / cfg.obs_path (NOOP when
+        # off).  The oracle's metrics (solve-time histograms, IPM
+        # iteration counters) are routed into the SAME registry unless
+        # the caller already wired the oracle to its own handle.
+        self.obs = obs if obs is not None else obs_lib.from_config(cfg)
+        self._owns_obs = obs is None
+        if (self.obs.enabled and getattr(oracle, "obs", None) is not None
+                and not oracle.obs.enabled):
+            oracle.obs = self.obs
+        self._obs_t0 = time.perf_counter()
+        self._prev_solves = oracle.n_solves
+        self._obs_regions0 = 0
         p = problem.n_theta
         self.tree = Tree(p=p, n_u=problem.n_u,
                          split_hyperplanes=getattr(
@@ -176,7 +191,11 @@ class FrontierEngine:
         oracle's statistics."""
         t0 = time.perf_counter()
         try:
-            return getattr(self.oracle, method)(*args)
+            # The span doubles as a device-trace annotation under
+            # obs='full', anchoring each synchronous oracle query on the
+            # host track of a jax.profiler capture.
+            with self.obs.span("oracle." + method):
+                return getattr(self.oracle, method)(*args)
         except (RuntimeError, OSError) as e:
             # XlaRuntimeError (dead tunnel, device OOM, interconnect
             # faults) subclasses RuntimeError; socket/tunnel drops raise
@@ -330,11 +349,12 @@ class FrontierEngine:
         gh = ph = None
         t0 = time.perf_counter()
         try:
-            if plan["grid_arr"] is not None:
-                gh = self.oracle.dispatch_vertices(plan["grid_arr"])
-            if plan["pair_slices"]:
-                ph = self.oracle.dispatch_pairs(plan["pair_t"],
-                                                plan["pair_d"])
+            with self.obs.span("build.dispatch"):
+                if plan["grid_arr"] is not None:
+                    gh = self.oracle.dispatch_vertices(plan["grid_arr"])
+                if plan["pair_slices"]:
+                    ph = self.oracle.dispatch_pairs(plan["pair_t"],
+                                                    plan["pair_d"])
         except (RuntimeError, OSError) as e:
             # Mark BOTH parts failed: a raising tunnel rarely delivers
             # the part that did not raise, and the fallback recomputes
@@ -358,15 +378,19 @@ class FrontierEngine:
         t0 = time.perf_counter()
         try:
             if plan["grid_arr"] is not None:
-                sol: VertexSolution = self._wait_or_fallback(
-                    "vertices", gh, (plan["grid_arr"],))
+                # Span = the device-blocking wait: wall >> cpu here is
+                # the per-step device_frac signal at span granularity.
+                with self.obs.span("build.wait_vertices"):
+                    sol: VertexSolution = self._wait_or_fallback(
+                        "vertices", gh, (plan["grid_arr"],))
                 for i, k in enumerate(plan["grid_keys"]):
                     self.cache.put_key(
                         k, (sol.V[i], sol.conv[i], sol.grad[i], sol.u0[i],
                             sol.z[i], sol.Vstar[i], sol.dstar[i], full))
             if plan["pair_slices"]:
-                V, conv, grad, u0, z = self._wait_or_fallback(
-                    "pairs", ph, (plan["pair_t"], plan["pair_d"]))
+                with self.obs.span("build.wait_pairs"):
+                    V, conv, grad, u0, z = self._wait_or_fallback(
+                        "pairs", ph, (plan["pair_t"], plan["pair_d"]))
                 nt, nu, nz = (self.problem.n_theta, self.problem.n_u,
                               self.oracle.can.nz)
                 for k, ds, lo in plan["pair_slices"]:
@@ -724,19 +748,47 @@ class FrontierEngine:
 
         self.steps += 1
         step_s = time.perf_counter() - t_step
+        regions = self.tree.n_regions()
+        # Fraction of the step spent blocked on oracle device programs
+        # -- the JSONL device-utilization proxy (SURVEY.md section 6.5;
+        # exact per-op device time lives in the --profile trace).
+        device_frac = round(self._oracle_s / max(step_s, 1e-9), 3)
         self.log.emit(step=self.steps, frontier=len(self.frontier),
                       batch=B, leaves=n_leaves, splits=n_splits,
-                      regions=self.tree.n_regions(),
+                      regions=regions,
                       solves=self.oracle.n_solves,
                       cached_vertices=len(self.cache),
                       step_s=round(step_s, 4),
                       oracle_s=round(self._oracle_s, 4),
-                      # Fraction of the step spent blocked on oracle
-                      # device programs -- the JSONL device-utilization
-                      # proxy (SURVEY.md section 6.5; exact per-op device
-                      # time lives in the --profile trace).
-                      device_frac=round(self._oracle_s / max(step_s, 1e-9),
-                                        3))
+                      device_frac=device_frac)
+        o = self.obs
+        if o.enabled:
+            m = o.metrics
+            m.counter("build.steps").inc()
+            m.counter("build.leaves").inc(n_leaves)
+            m.counter("build.splits").inc(n_splits)
+            m.counter("build.oracle_solves").inc(
+                self.oracle.n_solves - self._prev_solves)
+            self._prev_solves = self.oracle.n_solves
+            # build.regions doubles as the converged-leaf backlog:
+            # certified leaves accumulate in the tree until the
+            # bounded-memory export (PR 1) drains them post-build.
+            m.gauge("build.frontier").set(len(self.frontier))
+            m.gauge("build.regions").set(regions)
+            m.gauge("build.device_frac").set(device_frac)
+            # THIS SESSION's throughput (regions certified here over
+            # session wall): a resumed campaign must not divide prior
+            # sessions' regions by this session's clock.  The
+            # cumulative figure lives in stats_dict/build.done.
+            wall = time.perf_counter() - self._obs_t0
+            m.gauge("build.regions_per_s").set(
+                (regions - self._obs_regions0) / max(wall, 1e-9))
+            m.histogram("build.step_s").observe(step_s)
+            m.histogram("build.oracle_wait_s").observe(self._oracle_s)
+            o.event("build.step", step=self.steps, regions=regions,
+                    frontier=len(self.frontier), batch=B,
+                    leaves=n_leaves, splits=n_splits,
+                    step_s=round(step_s, 6), device_frac=device_frac)
 
     # -- full run ----------------------------------------------------------
 
@@ -754,30 +806,46 @@ class FrontierEngine:
             profiling = True
             self.log.emit(profiling=True, trace_dir=self.cfg.profile_path)
         try:
-            while self.frontier and self.steps < self.cfg.max_steps:
-                if (budget is not None
-                        and time.perf_counter() - t0 >= budget):
-                    self.log.emit(time_budget_hit=True, budget_s=budget)
-                    break
-                self.step()
-                if profiling and self.steps >= self.cfg.profile_steps:
+            try:
+                while self.frontier and self.steps < self.cfg.max_steps:
+                    if (budget is not None
+                            and time.perf_counter() - t0 >= budget):
+                        self.log.emit(time_budget_hit=True,
+                                      budget_s=budget)
+                        break
+                    self.step()
+                    if profiling and self.steps >= self.cfg.profile_steps:
+                        import jax
+
+                        jax.profiler.stop_trace()
+                        profiling = False
+                    if (self.cfg.checkpoint_every
+                            and self.steps % self.cfg.checkpoint_every == 0
+                            and self.cfg.checkpoint_path):
+                        self.save_checkpoint(self.cfg.checkpoint_path)
+            finally:
+                if profiling:
                     import jax
 
                     jax.profiler.stop_trace()
-                    profiling = False
-                if (self.cfg.checkpoint_every
-                        and self.steps % self.cfg.checkpoint_every == 0
-                        and self.cfg.checkpoint_path):
-                    self.save_checkpoint(self.cfg.checkpoint_path)
+            wall = time.perf_counter() - t0
+            stats = self.stats_dict(wall)
+            self.log.emit(done=True, **stats)
+            self.obs.event("build.done", **stats)
+            return PartitionResult(self.tree, self.roots, stats)
         finally:
-            if profiling:
-                import jax
+            self.finish_obs()
 
-                jax.profiler.stop_trace()
-        wall = time.perf_counter() - t0
-        stats = self.stats_dict(wall)
-        self.log.emit(done=True, **stats)
-        return PartitionResult(self.tree, self.roots, stats)
+    def finish_obs(self) -> None:
+        """Final metrics snapshot (+ close when the engine built the
+        handle from cfg).  Runs in run()'s outer finally so a crashed
+        build still ships its histograms -- the snapshot matters MOST
+        for the run that died; external step-loop drivers (long_build)
+        own their handle's lifecycle and close it themselves."""
+        if self.obs.enabled:
+            self.obs.flush_metrics()
+            if self._owns_obs:
+                self.obs.close(snapshot=False)
 
     def stats_dict(self, wall: float) -> dict:
         """The run-summary statistics dict for the build so far.
@@ -865,7 +933,8 @@ class FrontierEngine:
     @classmethod
     def resume(cls, snapshot: str | dict, problem, oracle: Oracle,
                log: RunLog | None = None,
-               cfg: PartitionConfig | None = None) -> "FrontierEngine":
+               cfg: PartitionConfig | None = None,
+               obs: "obs_lib.Obs | None" = None) -> "FrontierEngine":
         """Rebuild an engine from a checkpoint path or an already-loaded
         snapshot dict (checkpoints hold the whole tree + cache; callers
         that inspected the snapshot pass the dict to avoid a second
@@ -908,6 +977,17 @@ class FrontierEngine:
         oracle.n_point_solves = snap.get("n_point_solves", 0)
         oracle.n_simplex_solves = snap.get("n_simplex_solves", 0)
         oracle.n_rescue_solves = snap.get("n_rescue_solves", 0)
+        eng.obs = obs if obs is not None else obs_lib.from_config(eng.cfg)
+        eng._owns_obs = obs is None
+        if (eng.obs.enabled and getattr(oracle, "obs", None) is not None
+                and not oracle.obs.enabled):
+            oracle.obs = eng.obs
+        eng._obs_t0 = time.perf_counter()
+        # After the counter/tree restore above, so the first step's
+        # solve delta and the regions_per_s gauge count THIS session's
+        # work only.
+        eng._prev_solves = oracle.n_solves
+        eng._obs_regions0 = eng.tree.n_regions()
         # Rebuild the open-simplex refcounts from the restored frontier and
         # drop cache rows no open simplex references (the snapshot may
         # predate their eviction).
@@ -949,9 +1029,10 @@ def make_oracle(problem, cfg: PartitionConfig, mesh=None,
 
 
 def build_partition(problem, cfg: PartitionConfig,
-                    oracle: Oracle | None = None) -> PartitionResult:
+                    oracle: Oracle | None = None,
+                    obs: "obs_lib.Obs | None" = None) -> PartitionResult:
     """One-call offline build: problem + config -> certified partition."""
     if oracle is None:
         oracle = make_oracle(problem, cfg)
     log = RunLog(cfg.log_path, echo=False)
-    return FrontierEngine(problem, oracle, cfg, log).run()
+    return FrontierEngine(problem, oracle, cfg, log, obs=obs).run()

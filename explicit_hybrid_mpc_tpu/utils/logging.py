@@ -1,15 +1,24 @@
 """Structured JSONL metrics (SURVEY.md section 6.5 build obligation).
 
 The reference prints progress/ETA to stdout and pickles statistics
-[M-med]; here every frontier step emits one JSON line so runs are machine-
-readable (regions/sec is the north-star metric)."""
+[M-med]; here every frontier step emits one JSON line so runs are
+machine-readable (regions/sec is the north-star metric).
+
+RunLog predates the obs subsystem (explicit_hybrid_mpc_tpu/obs/) and is
+now a thin compatibility shim over its sink: same ``emit(**fields)``
+surface and flat JSONL layout (consumers grep for "step" / "done" /
+"device_frac" fields -- scripts/long_build.py, scripts/profile_capture,
+post.analysis.runtime_report), while gaining the sink's numpy coercion
+(build stats carry np.float32/np.int64 fields that used to crash
+json.dumps with a TypeError) and context-manager close-on-exception.
+New instrumentation should use obs.Obs directly; this class exists for
+the legacy per-step stream (PartitionConfig.log_path)."""
 
 from __future__ import annotations
 
-import json
-import sys
-import time
-from typing import IO, Optional
+from typing import Optional
+
+from explicit_hybrid_mpc_tpu.obs.sink import JsonlSink
 
 
 class RunLog:
@@ -20,20 +29,22 @@ class RunLog:
         the `t` column mid-file and any d(regions)/d(t) consumer computes
         garbage at the boundary; resume drivers (scripts/long_build.py)
         pass their recovered cumulative wall so t stays monotonic."""
-        self._fh: Optional[IO[str]] = open(path, "a") if path else None
-        self._echo = echo
-        self.t0 = time.perf_counter() - base_t
+        # keep=False: long-campaign streams are millions of lines, and
+        # RunLog's consumers read the FILE, never an in-memory list.
+        self.sink = JsonlSink(path, echo=echo, base_t=base_t, keep=False)
+
+    @property
+    def t0(self) -> float:
+        return self.sink.t0
 
     def emit(self, **fields) -> None:
-        rec = {"t": round(time.perf_counter() - self.t0, 4), **fields}
-        line = json.dumps(rec)
-        if self._fh:
-            self._fh.write(line + "\n")
-            self._fh.flush()
-        if self._echo:
-            print(line, file=sys.stderr)
+        self.sink.emit("event", "runlog", **fields)
 
     def close(self) -> None:
-        if self._fh:
-            self._fh.close()
-            self._fh = None
+        self.sink.close()
+
+    def __enter__(self) -> "RunLog":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -133,164 +133,188 @@ def run(result: dict, out_path: str) -> None:
         except Exception:
             pass
         result["resumed_base_wall_s"] = round(base_wall, 1)
-    runlog = RunLog(cfg.log_path, echo=False, base_t=base_wall)
-    if resuming:
-        log(f"resuming from {ckpt}")
-        import pickle
+    # RunLog and the obs handle are context managers (satellite fix,
+    # PR 2): a raise anywhere in the campaign -- device loss, OOM, a
+    # SystemExit from the checkpoint guard -- closes both JSONL streams
+    # instead of leaking the handles and truncating the last buffered
+    # records.  LONG_OBS (off/jsonl/full, default jsonl) streams the
+    # unified spans/metrics next to the artifact; scripts/obs_report.py
+    # renders it.
+    from explicit_hybrid_mpc_tpu import obs as obs_lib
 
-        with open(ckpt, "rb") as f:
-            snap = pickle.load(f)
-        # HARD compatibility check: a stale checkpoint at the default
-        # path combined with changed LONG_* knobs would silently
-        # continue a tree certified under DIFFERENT settings.
-        sc = snap["cfg"]
-        for fld in ("problem", "problem_args", "eps_a", "eps_r",
-                    "precision", "semi_explicit_boundary_depth"):
-            snap_v = getattr(sc, fld, None)
-            cfg_v = getattr(cfg, fld, None)
-            if snap_v != cfg_v:
-                raise SystemExit(
-                    f"checkpoint {ckpt} was built with {fld}={snap_v!r} "
-                    f"but this run requests {cfg_v!r}; move the "
-                    "checkpoint aside or match the knobs")
-        eng = FrontierEngine.resume(snap, problem, oracle, log=runlog,
-                                    cfg=cfg)
-        result["resumed_from_step"] = eng.steps
-    else:
-        eng = FrontierEngine(problem, oracle, cfg, log=runlog)
+    obs_mode = os.environ.get("LONG_OBS", "jsonl")
+    obs_path = (out_path.replace(".json", ".obs.jsonl")
+                if obs_mode != "off" else None)
+    result["obs_path"] = obs_path
+    with RunLog(cfg.log_path, echo=False, base_t=base_wall) as runlog, \
+            obs_lib.Obs(obs_mode, path=obs_path,
+                        base_t=base_wall) as build_obs:
+        if resuming:
+            log(f"resuming from {ckpt}")
+            import pickle
 
-    t0 = time.time()
-    paused_s = 0.0
+            with open(ckpt, "rb") as f:
+                snap = pickle.load(f)
+            # HARD compatibility check: a stale checkpoint at the default
+            # path combined with changed LONG_* knobs would silently
+            # continue a tree certified under DIFFERENT settings.
+            sc = snap["cfg"]
+            for fld in ("problem", "problem_args", "eps_a", "eps_r",
+                        "precision", "semi_explicit_boundary_depth"):
+                snap_v = getattr(sc, fld, None)
+                cfg_v = getattr(cfg, fld, None)
+                if snap_v != cfg_v:
+                    raise SystemExit(
+                        f"checkpoint {ckpt} was built with "
+                        f"{fld}={snap_v!r} but this run requests "
+                        f"{cfg_v!r}; move the checkpoint aside or match "
+                        "the knobs")
+            eng = FrontierEngine.resume(snap, problem, oracle, log=runlog,
+                                        cfg=cfg, obs=build_obs)
+            result["resumed_from_step"] = eng.steps
+        else:
+            eng = FrontierEngine(problem, oracle, cfg, log=runlog,
+                                 obs=build_obs)
 
-    def wall() -> float:
-        return base_wall + time.time() - t0 - paused_s
+        t0 = time.time()
+        paused_s = 0.0
 
-    last_ckpt_step = eng.steps
-    while eng.frontier:
-        regions = eng.tree.n_regions()
-        if target > 0 and regions >= target:
-            result["stop_reason"] = "target_regions"
-            break
-        if wall() - base_wall > budget:
-            result["stop_reason"] = "budget"
-            break
-        # Yield the single core to an active TPU capture window.  A
-        # sentinel whose mtime stopped advancing is an orphan (the
-        # watcher heartbeats it every 20 s but cannot unlink it if
-        # SIGKILLed): ignore it after 10 minutes of silence.
-        in_pause = False
-        while (os.path.exists(SENTINEL)
-               and time.time() - os.path.getmtime(SENTINEL) < 600):
-            if not in_pause:
-                log("capture window active: pausing build")
-                in_pause = True
-            time.sleep(30)
-            paused_s += 30.0
-        if in_pause:
-            log("capture window over: resuming build")
-        eng.step()
-        if eng.steps - last_ckpt_step >= ckpt_every:
-            last_ckpt_step = eng.steps
-            tck = time.time()
-            eng.save_checkpoint(ckpt)
-            stats = eng.stats_dict(wall())
-            row = {k: stats[k] for k in
-                   ("regions", "tree_nodes", "steps", "frontier_left",
-                    "oracle_solves", "cache_peak_vertices",
-                    "cache_peak_mb", "regions_per_s", "uncertified")}
-            row["ckpt_write_s"] = round(time.time() - tck, 1)
-            row["wall_s"] = round(wall(), 1)
-            result["progress"].append(row)
-            result["paused_for_captures_s"] = round(paused_s, 1)
-            write_out(out_path, result)
-            log(f"ckpt @ step {eng.steps}: {row['regions']} regions, "
-                f"{row['frontier_left']} open, "
-                f"{row['regions_per_s']:.0f} r/s, "
-                f"cache peak {row['cache_peak_mb']} MB, "
-                f"ckpt write {row['ckpt_write_s']}s")
-    else:
-        result["stop_reason"] = "drained"
-    eng.save_checkpoint(ckpt)
+        def wall() -> float:
+            return base_wall + time.time() - t0 - paused_s
 
-    total_wall = wall()
-    stats = eng.stats_dict(total_wall)
-    result["stats"] = stats
-    result["paused_for_captures_s"] = round(paused_s, 1)
-    write_out(out_path, result)
-    log(f"build stopped ({result['stop_reason']}): "
-        f"{stats['regions']} regions in {total_wall:.0f}s")
+        last_ckpt_step = eng.steps
+        while eng.frontier:
+            regions = eng.tree.n_regions()
+            if target > 0 and regions >= target:
+                result["stop_reason"] = "target_regions"
+                break
+            if wall() - base_wall > budget:
+                result["stop_reason"] = "budget"
+                break
+            # Yield the single core to an active TPU capture window.  A
+            # sentinel whose mtime stopped advancing is an orphan (the
+            # watcher heartbeats it every 20 s but cannot unlink it if
+            # SIGKILLed): ignore it after 10 minutes of silence.
+            in_pause = False
+            while (os.path.exists(SENTINEL)
+                   and time.time() - os.path.getmtime(SENTINEL) < 600):
+                if not in_pause:
+                    log("capture window active: pausing build")
+                    in_pause = True
+                time.sleep(30)
+                paused_s += 30.0
+            if in_pause:
+                log("capture window over: resuming build")
+            eng.step()
+            if eng.steps - last_ckpt_step >= ckpt_every:
+                last_ckpt_step = eng.steps
+                tck = time.time()
+                eng.save_checkpoint(ckpt)
+                stats = eng.stats_dict(wall())
+                row = {k: stats[k] for k in
+                       ("regions", "tree_nodes", "steps", "frontier_left",
+                        "oracle_solves", "cache_peak_vertices",
+                        "cache_peak_mb", "regions_per_s", "uncertified")}
+                row["ckpt_write_s"] = round(time.time() - tck, 1)
+                row["wall_s"] = round(wall(), 1)
+                result["progress"].append(row)
+                result["paused_for_captures_s"] = round(paused_s, 1)
+                write_out(out_path, result)
+                # Metrics snapshot per checkpoint: the obs stream gets a
+                # resumable trajectory of counters/histograms, not just
+                # one end-of-run point.
+                build_obs.flush_metrics()
+                log(f"ckpt @ step {eng.steps}: {row['regions']} regions, "
+                    f"{row['frontier_left']} open, "
+                    f"{row['regions_per_s']:.0f} r/s, "
+                    f"cache peak {row['cache_peak_mb']} MB, "
+                    f"ckpt write {row['ckpt_write_s']}s")
+        else:
+            result["stop_reason"] = "drained"
+        eng.save_checkpoint(ckpt)
 
-    # -- online path at final scale (the verdict's evidence fields) -------
-    import resource
+        total_wall = wall()
+        stats = eng.stats_dict(total_wall)
+        result["stats"] = stats
+        result["paused_for_captures_s"] = round(paused_s, 1)
+        write_out(out_path, result)
+        build_obs.event("build.done", **stats)
+        log(f"build stopped ({result['stop_reason']}): "
+            f"{stats['regions']} regions in {total_wall:.0f}s")
 
-    import jax
-    import jax.numpy as jnp
+        # -- online path at final scale (the verdict's evidence fields) ----
+        import resource
 
-    from explicit_hybrid_mpc_tpu.online import (descent, evaluator, export,
-                                                sharded)
+        import jax
+        import jax.numpy as jnp
 
-    # Streamed memmap export next to the live tree: O(chunk) additional
-    # RSS instead of a second O(L) in-RAM table (the 9.8M-leaf ledger
-    # peaked at 94.8 GB with the in-RAM path), and the artifacts deploy
-    # the online stage without the pickled tree.
-    exp_dir = os.environ.get("LONG_EXPORT_DIR",
-                             os.path.join(ART, "leaf_table"))
-    rss0 = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
-    t = time.time()
-    export.write_leaf_table(eng.tree, exp_dir)
-    result["export_leaves_s"] = round(time.time() - t, 2)
-    result["export_rss_delta_mb"] = round(
-        (resource.getrusage(resource.RUSAGE_SELF).ru_maxrss - rss0)
-        / 1024, 1)
-    table = export.load_leaf_table(exp_dir)
-    t = time.time()
-    dt = descent.export_descent(eng.tree, eng.roots, table, stage=False)
-    descent.save_descent(dt, os.path.join(exp_dir, "descent.npz"))
-    result["export_descent_s"] = round(time.time() - t, 2)
-    result["split_hyperplanes"] = eng.tree.split_hyperplanes_available()
-    dt_dev = jax.tree_util.tree_map(jnp.asarray, dt)
-    dev = evaluator.stage(table)
-    rng = np.random.default_rng(3)
-    B = 4096
-    qs_np = rng.uniform(problem.theta_lb, problem.theta_ub,
-                        size=(B, problem.n_theta))
-    qs = jnp.asarray(qs_np)
-    jax.block_until_ready(descent.evaluate_descent(dt_dev, dev, qs))
-    t = time.time()
-    reps = 5
-    for _ in range(reps):
-        out = descent.evaluate_descent(dt_dev, dev, qs)
-    jax.block_until_ready(out)
-    result["online_us_per_query"] = round(
-        (time.time() - t) / (reps * B) * 1e6, 3)
-    result["online_leaves"] = int(table.n_leaves)
-    result["online_path"] = "descent"
-    # Sharded serving figure at the same scale (compacted per-shard
-    # tables + analytic Kuhn root routing over the problem's box).
-    try:
-        from explicit_hybrid_mpc_tpu.partition import geometry
+        from explicit_hybrid_mpc_tpu.online import (descent, evaluator,
+                                                    export, sharded)
 
-        router = geometry.kuhn_root_locator(
-            problem.theta_lb, problem.theta_ub,
-            getattr(problem, "root_splits", None))
-        srv = sharded.shard_descent(
-            dt, table,
-            n_shards=int(os.environ.get("LONG_SHARDS", "8")),
-            router=router)
-        srv.evaluate(qs_np)
+        # Streamed memmap export next to the live tree: O(chunk)
+        # additional RSS instead of a second O(L) in-RAM table (the
+        # 9.8M-leaf ledger peaked at 94.8 GB with the in-RAM path), and
+        # the artifacts deploy the online stage without the pickled tree.
+        exp_dir = os.environ.get("LONG_EXPORT_DIR",
+                                 os.path.join(ART, "leaf_table"))
+        rss0 = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
         t = time.time()
+        with build_obs.span("export.leaves"):
+            export.write_leaf_table(eng.tree, exp_dir)
+        result["export_leaves_s"] = round(time.time() - t, 2)
+        result["export_rss_delta_mb"] = round(
+            (resource.getrusage(resource.RUSAGE_SELF).ru_maxrss - rss0)
+            / 1024, 1)
+        table = export.load_leaf_table(exp_dir)
+        t = time.time()
+        dt = descent.export_descent(eng.tree, eng.roots, table,
+                                    stage=False, obs=build_obs)
+        descent.save_descent(dt, os.path.join(exp_dir, "descent.npz"))
+        result["export_descent_s"] = round(time.time() - t, 2)
+        result["split_hyperplanes"] = eng.tree.split_hyperplanes_available()
+        dt_dev = jax.tree_util.tree_map(jnp.asarray, dt)
+        dev = evaluator.stage(table, obs=build_obs)
+        rng = np.random.default_rng(3)
+        B = 4096
+        qs_np = rng.uniform(problem.theta_lb, problem.theta_ub,
+                            size=(B, problem.n_theta))
+        qs = jnp.asarray(qs_np)
+        jax.block_until_ready(descent.evaluate_descent(dt_dev, dev, qs))
+        t = time.time()
+        reps = 5
         for _ in range(reps):
-            srv.evaluate(qs_np)
-        result["online_us_per_query_sharded"] = round(
+            out = descent.evaluate_descent(dt_dev, dev, qs)
+        jax.block_until_ready(out)
+        result["online_us_per_query"] = round(
             (time.time() - t) / (reps * B) * 1e6, 3)
-        result["online_shards"] = srv.n_shards
-    except Exception as e:  # serving figure is an extra, never fatal
-        log(f"sharded online figure skipped: {e!r}")
-    write_out(out_path, result)
-    log(f"online: {result['online_us_per_query']} us/q "
-        f"(sharded {result.get('online_us_per_query_sharded')}) over "
-        f"{table.n_leaves} leaves "
-        f"(export {result['export_descent_s']}s)")
+        result["online_leaves"] = int(table.n_leaves)
+        result["online_path"] = "descent"
+        # Sharded serving figure at the same scale (compacted per-shard
+        # tables + analytic Kuhn root routing over the problem's box).
+        try:
+            from explicit_hybrid_mpc_tpu.partition import geometry
+
+            router = geometry.kuhn_root_locator(
+                problem.theta_lb, problem.theta_ub,
+                getattr(problem, "root_splits", None))
+            srv = sharded.shard_descent(
+                dt, table,
+                n_shards=int(os.environ.get("LONG_SHARDS", "8")),
+                router=router, obs=build_obs)
+            srv.evaluate(qs_np)
+            t = time.time()
+            for _ in range(reps):
+                srv.evaluate(qs_np)
+            result["online_us_per_query_sharded"] = round(
+                (time.time() - t) / (reps * B) * 1e6, 3)
+            result["online_shards"] = srv.n_shards
+        except Exception as e:  # serving figure is an extra, never fatal
+            log(f"sharded online figure skipped: {e!r}")
+        write_out(out_path, result)
+        log(f"online: {result['online_us_per_query']} us/q "
+            f"(sharded {result.get('online_us_per_query_sharded')}) over "
+            f"{table.n_leaves} leaves "
+            f"(export {result['export_descent_s']}s)")
 
 
 def main() -> int:

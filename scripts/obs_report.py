@@ -1,0 +1,236 @@
+"""Render a run report from an obs JSONL stream; diff it against the
+last BENCH_*.json to flag regressions.
+
+Reads the unified observability stream (explicit_hybrid_mpc_tpu/obs/,
+schema in docs/observability.md) that a build and/or serving session
+wrote (cfg.obs='jsonl', LONG_OBS, or an explicit obs.Obs handle) and
+prints:
+
+- build throughput: steps, regions, regions/sec, device_frac trend;
+- oracle solve-time p50/p99 per QP class (point/simplex/rescue) plus
+  IPM iteration volume, from the last metrics snapshot's histograms;
+- serving: per-shard query-latency p50/p99, batch sizes, routing mode
+  counts, shard imbalance;
+- a diff against a BENCH_*.json (default: the newest in the repo root)
+  flagging >tol regressions in regions/sec and histogram p99s against
+  the bench's own `metrics` block.
+
+Usage:
+    python scripts/obs_report.py RUN.obs.jsonl [--bench BENCH.json]
+        [--json OUT.json] [--tol 0.10]
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from explicit_hybrid_mpc_tpu.obs.metrics import histogram_row  # noqa: E402
+from explicit_hybrid_mpc_tpu.obs.sink import (  # noqa: E402
+    SCHEMA_VERSION, load_jsonl)
+
+_SHARD_PREFIX = "serve.shard"
+
+
+def report(records: list[dict]) -> dict:
+    """Structured report dict from parsed stream records.  Tolerates
+    partial streams (build-only, serve-only); keys are present only
+    when their producers emitted."""
+    out: dict = {"n_records": len(records)}
+    meta = [r for r in records
+            if r.get("kind") == "meta" and r.get("name") == "schema"]
+    out["schema_version"] = meta[-1].get("version") if meta else None
+    if out["schema_version"] not in (None, SCHEMA_VERSION):
+        out["schema_warning"] = (
+            f"stream schema v{out['schema_version']} != reader "
+            f"v{SCHEMA_VERSION}; fields may have moved")
+
+    # -- build trajectory (per-step events) --------------------------------
+    steps = [r for r in records
+             if r.get("kind") == "event" and r.get("name") == "build.step"]
+    if steps:
+        last = steps[-1]
+        dfrac = [r["device_frac"] for r in steps if "device_frac" in r]
+        out["build"] = {
+            "steps": last.get("step"),
+            "regions": last.get("regions"),
+            "frontier_left": last.get("frontier"),
+            "wall_s": last["t"],
+            "regions_per_s": (last.get("regions", 0)
+                              / max(last["t"], 1e-9)),
+            "device_frac_mean": (sum(dfrac) / len(dfrac)
+                                 if dfrac else None),
+        }
+    done = [r for r in records
+            if r.get("kind") == "event" and r.get("name") == "build.done"]
+    if done:
+        out.setdefault("build", {})["done"] = {
+            k: v for k, v in done[-1].items()
+            if k not in ("t", "kind", "name")}
+        # Prefer the engine's own cumulative figure when present (it
+        # accounts resumed-session base wall; the step-event ratio is
+        # session-local).
+        rps = done[-1].get("regions_per_s")
+        if rps is not None:
+            out["build"]["regions_per_s"] = rps
+            out["build"]["regions"] = done[-1].get(
+                "regions", out["build"].get("regions"))
+
+    # -- metrics snapshot (the last one wins: snapshots are cumulative) ----
+    snaps = [r for r in records if r.get("kind") == "metrics"]
+    if snaps:
+        snap = snaps[-1]
+        out["counters"] = snap.get("counters", {})
+        out["gauges"] = snap.get("gauges", {})
+        hists = snap.get("histograms", {})
+        out["histograms"] = {k: histogram_row(h) for k, h in hists.items()}
+        oracle = {k.split(".", 1)[1]: v for k, v in out["histograms"].items()
+                  if k.startswith("oracle.")}
+        if oracle:
+            out["oracle"] = oracle
+            out["oracle"]["ipm_iters"] = out["counters"].get(
+                "oracle.ipm_iters")
+        shards = {}
+        for k, v in out["histograms"].items():
+            if k.startswith(_SHARD_PREFIX) and k.endswith(".query_s"):
+                sid = k[len(_SHARD_PREFIX):].split(".", 1)[0]
+                shards[sid] = v
+        if shards or any(k.startswith("serve.") for k in out["gauges"]):
+            out["serve"] = {
+                "shards": shards,
+                "imbalance": out["gauges"].get("serve.shard_imbalance"),
+                "queries": out["counters"].get("serve.queries"),
+                "route_analytic": out["counters"].get(
+                    "serve.route_analytic_queries", 0),
+                "route_brute": out["counters"].get(
+                    "serve.route_brute_queries", 0),
+                "query_s": out["histograms"].get("serve.query_s"),
+            }
+    return out
+
+
+def latest_bench(repo_dir: str = REPO) -> str | None:
+    paths = sorted(glob.glob(os.path.join(repo_dir, "BENCH_*.json")))
+    return paths[-1] if paths else None
+
+
+def diff_bench(rep: dict, bench: dict, tol: float = 0.10) -> list[str]:
+    """Regression flags: this run vs a BENCH_*.json.  Directional --
+    only worse-than-bench beyond `tol` is flagged (a faster run is not
+    a regression)."""
+    flags: list[str] = []
+    bval = bench.get("value")
+    rps = rep.get("build", {}).get("regions_per_s")
+    if bval and rps and rps < (1 - tol) * bval:
+        flags.append(
+            f"regions/s regression: {rps:.1f} vs bench {bval:.1f} "
+            f"({100 * (1 - rps / bval):.0f}% slower)")
+    bhists = bench.get("metrics", {}).get("histograms", {})
+    for name, row in rep.get("histograms", {}).items():
+        brow = bhists.get(name)
+        if not brow:
+            continue
+        bp99, p99 = brow.get("p99"), row.get("p99")
+        if bp99 and p99 and p99 > (1 + tol) * bp99:
+            flags.append(
+                f"{name} p99 regression: {p99:.3g}s vs bench "
+                f"{bp99:.3g}s ({100 * (p99 / bp99 - 1):.0f}% slower)")
+    # Serving headline: sharded us/query against the bench's large-L
+    # figure, when both sides measured it.
+    b_us = bench.get("large_l_sharded_us_per_query")
+    q = rep.get("serve", {}).get("query_s") or {}
+    if b_us and q.get("p50"):
+        us = q["p50"] * 1e6
+        if us > (1 + tol) * b_us:
+            flags.append(
+                f"sharded serving p50 regression: {us:.2f} us/q vs "
+                f"bench {b_us:.2f} us/q")
+    return flags
+
+
+def _fmt_lat(v: float | None) -> str:
+    if v is None:
+        return "-"
+    return f"{v * 1e6:.2f}us" if v < 1e-3 else f"{v * 1e3:.2f}ms" \
+        if v < 1.0 else f"{v:.2f}s"
+
+
+def render_text(rep: dict, flags: list[str], bench_path: str | None) -> str:
+    ln = [f"obs report: {rep['n_records']} records, schema "
+          f"v{rep.get('schema_version')}"]
+    b = rep.get("build")
+    if b:
+        ln.append(f"build: {b.get('regions')} regions in "
+                  f"{b.get('wall_s', 0):.1f}s "
+                  f"({b.get('regions_per_s', 0):.1f} regions/s, "
+                  f"{b.get('steps')} steps, device_frac mean "
+                  f"{(b.get('device_frac_mean') or 0):.2f})")
+    orc = rep.get("oracle")
+    if orc:
+        for cls in ("point_solve_s", "simplex_solve_s", "rescue_solve_s"):
+            row = orc.get(cls)
+            if row:
+                ln.append(f"oracle {cls.split('_')[0]}: "
+                          f"{row['count']} QPs, p50 "
+                          f"{_fmt_lat(row['p50'])}, p99 "
+                          f"{_fmt_lat(row['p99'])}")
+        if orc.get("ipm_iters"):
+            ln.append(f"oracle IPM iterations: {orc['ipm_iters']}")
+    srv = rep.get("serve")
+    if srv:
+        ln.append(f"serve: {srv.get('queries')} queries "
+                  f"(route analytic/brute: {srv.get('route_analytic')}/"
+                  f"{srv.get('route_brute')}), shard imbalance "
+                  f"{(srv.get('imbalance') or 0):.2f}")
+        q = srv.get("query_s")
+        if q:
+            ln.append(f"serve latency: p50 {_fmt_lat(q['p50'])}, "
+                      f"p99 {_fmt_lat(q['p99'])} per query")
+        for sid in sorted(srv.get("shards", {})):
+            row = srv["shards"][sid]
+            ln.append(f"  shard {sid}: {row['count']} queries, p50 "
+                      f"{_fmt_lat(row['p50'])}, p99 {_fmt_lat(row['p99'])}")
+    if bench_path:
+        ln.append(f"bench diff vs {os.path.basename(bench_path)}: "
+                  + ("OK" if not flags else f"{len(flags)} flag(s)"))
+        for f in flags:
+            ln.append(f"  REGRESSION: {f}")
+    return "\n".join(ln)
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("stream", help="obs JSONL stream path")
+    ap.add_argument("--bench", default=None,
+                    help="BENCH_*.json to diff against "
+                         "(default: newest in the repo root)")
+    ap.add_argument("--json", dest="json_out", default=None,
+                    help="also write the structured report here")
+    ap.add_argument("--tol", type=float, default=0.10,
+                    help="relative regression tolerance (default 0.10)")
+    args = ap.parse_args(argv)
+
+    rep = report(load_jsonl(args.stream))
+    bench_path = args.bench or latest_bench()
+    flags: list[str] = []
+    if bench_path and os.path.exists(bench_path):
+        with open(bench_path) as f:
+            flags = diff_bench(rep, json.load(f), tol=args.tol)
+    else:
+        bench_path = None
+    print(render_text(rep, flags, bench_path))
+    if args.json_out:
+        with open(args.json_out, "w") as f:
+            json.dump({"report": rep, "bench": bench_path,
+                       "bench_flags": flags}, f, indent=2)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -71,6 +71,7 @@ _SLOW = (
     "test_bnb.py::test_bnb_matches_enumeration",
     "test_bnb.py::test_pruning_happens",
     "test_inverted_pendulum.py::test_partition_build_certifies",
+    "test_obs_schema.py::test_obs_off_overhead_under_one_percent",
     "test_ipm.py::test_random_qp_matches_scipy",
     "test_ipm.py::test_mixed_precision_matches_f64",
     "test_online.py::test_descent_hybrid_partition",

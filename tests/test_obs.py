@@ -1,0 +1,365 @@
+"""Unit tests for the obs subsystem: sink, tracer, metrics registry,
+the RunLog compatibility shim, the relocated ContentionMonitor, and
+scripts/profile_capture.summarize_trace."""
+
+import gzip
+import json
+import os
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+from explicit_hybrid_mpc_tpu import obs as obs_lib
+from explicit_hybrid_mpc_tpu.obs.host import ContentionMonitor
+from explicit_hybrid_mpc_tpu.obs.metrics import (Histogram,
+                                                 MetricsRegistry, quantile)
+from explicit_hybrid_mpc_tpu.obs.sink import (SCHEMA_VERSION, JsonlSink,
+                                              load_jsonl)
+from explicit_hybrid_mpc_tpu.utils.logging import RunLog
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# -- sink ------------------------------------------------------------------
+
+def test_sink_coerces_numpy(tmp_path):
+    p = str(tmp_path / "s.jsonl")
+    with JsonlSink(p) as s:
+        s.emit("event", "e", a=np.float32(1.5), b=np.int64(7),
+               c=np.arange(3), d=np.bool_(True))
+    (rec,) = load_jsonl(p)
+    assert rec["a"] == 1.5 and rec["b"] == 7
+    assert rec["c"] == [0, 1, 2] and rec["d"] is True
+
+
+def test_sink_closes_on_exception(tmp_path):
+    p = str(tmp_path / "s.jsonl")
+    with pytest.raises(RuntimeError):
+        with JsonlSink(p) as s:
+            s.emit("event", "e")
+            raise RuntimeError("boom")
+    assert s._fh is None  # handle closed despite the raise
+    assert len(load_jsonl(p)) == 1
+
+
+def test_sink_base_t_monotonic():
+    s = JsonlSink(base_t=100.0)
+    rec = s.emit("event", "e")
+    assert rec["t"] >= 100.0
+
+
+def test_sink_bounds_memory_but_not_file(tmp_path):
+    p = str(tmp_path / "s.jsonl")
+    with JsonlSink(p, max_records=3) as s:
+        for i in range(5):
+            s.emit("event", "e", i=i)
+    assert len(s.records) == 3 and s.n_dropped == 2
+    assert len(load_jsonl(p)) == 5  # the file keeps everything
+
+
+def test_sink_thread_safe(tmp_path):
+    p = str(tmp_path / "s.jsonl")
+    s = JsonlSink(p)
+
+    def worker(k):
+        for i in range(50):
+            s.emit("event", f"w{k}", i=i)
+
+    ts = [threading.Thread(target=worker, args=(k,)) for k in range(4)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    s.close()
+    recs = load_jsonl(p)
+    assert len(recs) == 200 == len(s.records)
+
+
+# -- RunLog shim (satellite regressions) -----------------------------------
+
+def test_runlog_numpy_scalars_do_not_crash(tmp_path):
+    """json.dumps used to TypeError on numpy fields in the stats dict."""
+    p = str(tmp_path / "r.jsonl")
+    log = RunLog(p, echo=False)
+    log.emit(step=np.int64(3), regions_per_s=np.float32(17.5),
+             grad=np.zeros(2))
+    log.close()
+    (rec,) = load_jsonl(p)
+    assert rec["step"] == 3 and rec["regions_per_s"] == 17.5
+
+
+def test_runlog_is_context_manager(tmp_path):
+    p = str(tmp_path / "r.jsonl")
+    with pytest.raises(ValueError):
+        with RunLog(p, echo=False) as log:
+            log.emit(step=1)
+            raise ValueError("boom")
+    assert log.sink._fh is None
+    assert load_jsonl(p)[0]["step"] == 1
+
+
+def test_runlog_legacy_layout_and_consumers(tmp_path):
+    """Flat top-level fields + t, parseable by post.analysis."""
+    from explicit_hybrid_mpc_tpu.post import load_runlog, runtime_report
+
+    p = str(tmp_path / "r.jsonl")
+    with RunLog(p, echo=False) as log:
+        for k in range(3):
+            log.emit(step=k + 1, regions=10 * (k + 1), frontier=5,
+                     solves=7, step_s=0.1, device_frac=0.5)
+        log.emit(done=True, regions=30, steps=3)
+    recs = load_runlog(p)
+    rep = runtime_report(recs)
+    assert rep["n_steps"] == 3
+    assert rep["regions_final"] == 30
+    assert rep["final_stats"]["regions"] == 30
+
+
+# -- tracer ----------------------------------------------------------------
+
+def test_tracer_nesting_and_cpu_time(tmp_path):
+    o = obs_lib.Obs("jsonl")
+    with o.span("outer") as sp:
+        sp["extra"] = 42
+        with o.span("inner"):
+            sum(range(10000))
+    recs = o.sink.records
+    inner = next(r for r in recs if r["name"] == "inner")
+    outer = next(r for r in recs if r["name"] == "outer")
+    assert inner["depth"] == 1 and inner["parent"] == "outer"
+    assert outer["depth"] == 0 and outer["parent"] is None
+    assert outer["extra"] == 42
+    assert outer["wall_s"] >= inner["wall_s"] >= 0.0
+    assert outer["cpu_s"] >= 0.0
+
+
+def test_span_emitted_even_on_exception():
+    o = obs_lib.Obs("jsonl")
+    with pytest.raises(RuntimeError):
+        with o.span("fails"):
+            raise RuntimeError("boom")
+    assert any(r["name"] == "fails" for r in o.sink.records)
+
+
+# -- metrics ---------------------------------------------------------------
+
+def test_histogram_counts_sum_and_weighted_observe():
+    h = Histogram()
+    h.observe(1e-5, n=10)
+    h.observe(1e-3, n=5)
+    h.observe(2.0)
+    snap = h.snapshot()
+    assert sum(snap["counts"]) == snap["count"] == 16
+    assert snap["min"] == 1e-5 and snap["max"] == 2.0
+    np.testing.assert_allclose(snap["sum"], 10e-5 + 5e-3 + 2.0)
+
+
+def test_histogram_quantiles_are_sane():
+    h = Histogram()
+    rng = np.random.default_rng(0)
+    vals = 10.0 ** rng.uniform(-6, -3, size=2000)
+    for v in vals:
+        h.observe(float(v))
+    snap = h.snapshot()
+    p50 = quantile(snap, 0.5)
+    p99 = quantile(snap, 0.99)
+    # Log-bucket estimate: within one bucket ratio (10^(1/5)) of truth.
+    assert np.quantile(vals, 0.5) / 1.6 <= p50 <= np.quantile(vals, 0.5) * 1.6
+    assert p99 >= p50
+    assert quantile(snap, 0.0) >= snap["min"]
+    assert quantile(snap, 1.0) <= snap["max"] * (1 + 1e-12)
+    assert quantile({"count": 0, "bounds": [], "counts": [0],
+                     "sum": 0.0, "min": None, "max": None}, 0.5) is None
+
+
+def test_registry_snapshot_and_summary():
+    m = MetricsRegistry()
+    m.counter("a.count").inc(3)
+    m.counter("a.count").inc()
+    m.gauge("a.gauge").set(2.5)
+    m.histogram("a.lat_s").observe(0.01, n=4)
+    snap = m.snapshot()
+    assert snap["counters"]["a.count"] == 4
+    assert snap["gauges"]["a.gauge"] == 2.5
+    assert snap["histograms"]["a.lat_s"]["count"] == 4
+    summ = m.summary()
+    row = summ["histograms"]["a.lat_s"]
+    assert row["count"] == 4 and row["p50"] > 0 and row["p99"] > 0
+    json.dumps(summ)  # JSON-ready
+
+
+def test_registry_emit_record_shape():
+    o = obs_lib.Obs("jsonl")
+    o.counter("c").inc()
+    o.flush_metrics()
+    rec = next(r for r in o.sink.records if r["kind"] == "metrics")
+    assert rec["name"] == "snapshot" and rec["counters"]["c"] == 1
+
+
+# -- Obs facade ------------------------------------------------------------
+
+def test_obs_off_is_noop():
+    o = obs_lib.NOOP
+    assert not o.enabled and o.sink is None
+    with o.span("x") as sp:
+        sp["k"] = 1  # shared dict; must not raise
+    o.counter("c").inc()
+    o.gauge("g").set(1.0)
+    o.histogram("h").observe(0.1, n=5)
+    o.event("e", a=1)
+    o.flush_metrics()
+    o.close()  # all no-ops
+
+
+def test_obs_mode_validation():
+    with pytest.raises(ValueError):
+        obs_lib.Obs("bogus")
+    from explicit_hybrid_mpc_tpu.config import PartitionConfig
+    with pytest.raises(ValueError):
+        PartitionConfig(obs="bogus")
+    cfg = PartitionConfig(obs="jsonl")
+    o = obs_lib.from_config(cfg)
+    assert o.enabled and o.mode == "jsonl"
+    assert obs_lib.from_config(PartitionConfig()) is obs_lib.NOOP
+
+
+def test_obs_stream_has_schema_header(tmp_path):
+    p = str(tmp_path / "o.jsonl")
+    with obs_lib.Obs("jsonl", path=p):
+        pass
+    first = load_jsonl(p)[0]
+    assert first["kind"] == "meta" and first["name"] == "schema"
+    assert first["version"] == SCHEMA_VERSION
+
+
+def test_obs_default_handle_roundtrip():
+    o = obs_lib.Obs("jsonl")
+    try:
+        assert obs_lib.set_default(o) is o
+        assert obs_lib.default() is o
+    finally:
+        obs_lib.set_default(None)
+    assert obs_lib.default() is obs_lib.NOOP
+
+
+# -- ContentionMonitor (satellite: fake /proc readers) ---------------------
+
+def test_monitor_competing_frac_arithmetic():
+    # 100 busy jiffies total, 40 of them ours -> 60 competing over a
+    # 120-jiffy capacity = 0.5.
+    assert ContentionMonitor._competing_frac((0, 0), (100, 40), 120.0) \
+        == 0.5
+    # Clamped to [0, 1].
+    assert ContentionMonitor._competing_frac((0, 0), (500, 0), 100.0) == 1.0
+    assert ContentionMonitor._competing_frac((0, 0), (10, 50), 100.0) == 0.0
+
+
+def test_monitor_fake_proc_stat_guest_subtraction(tmp_path):
+    """The real file-parsing path, on fixture files: guest/guest_nice
+    ticks (already inside user/nice) must come off the busy total."""
+    stat = tmp_path / "stat"
+    self_stat = tmp_path / "self_stat"
+    # user nice system idle iowait irq softirq steal guest guest_nice
+    stat.write_text("cpu 100 10 50 900 30 5 5 10 40 2\nrest ignored\n")
+    self_stat.write_text(
+        "1 (proc name) S " + " ".join(str(i) for i in range(9, 31)) + "\n")
+    mon = ContentionMonitor(stat_path=str(stat),
+                            self_stat_path=str(self_stat))
+    busy, own = mon._jiffies()
+    assert busy == 100 + 10 + 50 + 5 + 5 + 10  # guest ticks excluded
+    # utime stime cutime cstime = post-comm fields 11..14 = 19 20 21 22
+    assert own == 19 + 20 + 21 + 22
+    # Advance the files by +100 user jiffies that are ALL guest time
+    # (the kernel accounts guest inside user AND in the guest field):
+    # the busy delta must count that work exactly ONCE, not twice.
+    stat.write_text("cpu 200 10 50 900 30 5 5 10 140 2\n")
+    busy2, _ = mon._jiffies()
+    assert busy2 - busy == 100
+
+
+def test_monitor_scripted_reader_and_gauge_folding():
+    m = MetricsRegistry()
+    seq = [(0, 0), (100, 10), (200, 20), (300, 30), (400, 40)]
+    it = iter(seq + [seq[-1]] * 50)
+    mon = ContentionMonitor(interval_s=0.01, threshold=0.01, metrics=m,
+                            reader=lambda: next(it))
+    mon.start()
+    import time as _t
+    _t.sleep(0.15)
+    s = mon.summary()
+    assert s.get("competing_cpu_frac_mean", 0) > 0.0
+    assert "contended" in s
+    snap = m.snapshot()["gauges"]
+    assert snap["host.competing_cpu_frac_mean"] == \
+        s["competing_cpu_frac_mean"]
+    assert snap["host.contended"] == float(s["contended"])
+
+
+def test_monitor_degrades_without_procfs(tmp_path):
+    mon = ContentionMonitor(stat_path=str(tmp_path / "missing"),
+                            self_stat_path=str(tmp_path / "missing2"))
+    assert mon._jiffies() is None
+    mon.start()  # must not spawn a crashing thread
+    s = mon.summary()
+    assert "competing_cpu_frac_mean" not in s
+
+
+def test_monitor_reexports():
+    from explicit_hybrid_mpc_tpu.parallel.mesh import \
+        ContentionMonitor as MeshCM
+    assert MeshCM is ContentionMonitor
+    sys.path.insert(0, REPO)
+    try:
+        import bench
+        assert bench.ContentionMonitor is ContentionMonitor
+    finally:
+        sys.path.remove(REPO)
+
+
+# -- profile_capture.summarize_trace (satellite) ---------------------------
+
+def _write_trace(dirpath, events):
+    run = os.path.join(dirpath, "plugins", "profile", "run1")
+    os.makedirs(run)
+    path = os.path.join(run, "host.trace.json.gz")
+    with gzip.open(path, "wt") as f:
+        json.dump({"traceEvents": events}, f)
+    return path
+
+
+def test_summarize_trace_top_ops(tmp_path):
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    try:
+        from profile_capture import summarize_trace
+    finally:
+        sys.path.pop(0)
+    events = [
+        {"ph": "M", "name": "process_name", "pid": 1,
+         "args": {"name": "/device:TPU:0"}},
+        {"ph": "X", "name": "fusion.1", "dur": 1500.0, "ts": 0},
+        {"ph": "X", "name": "fusion.1", "dur": 500.0, "ts": 10},
+        {"ph": "X", "name": "cholesky", "dur": 3000.0, "ts": 20},
+        {"ph": "B", "name": "not_complete", "ts": 30},  # ignored
+    ]
+    _write_trace(str(tmp_path), events)
+    out = summarize_trace(str(tmp_path), top_n=5)
+    assert out["trace_files"] == 1
+    assert out["events"] == 3
+    assert out["tracks"] == ["/device:TPU:0"]
+    top = {r["name"]: r["total_ms"] for r in out["top_ops_ms"]}
+    assert top["cholesky"] == 3.0
+    assert top["fusion.1"] == 2.0  # summed across events
+    # Sorted by total duration, descending.
+    assert out["top_ops_ms"][0]["name"] == "cholesky"
+
+
+def test_summarize_trace_missing_dir(tmp_path):
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    try:
+        from profile_capture import summarize_trace
+    finally:
+        sys.path.pop(0)
+    out = summarize_trace(str(tmp_path / "nope"))
+    assert "error" in out

@@ -28,8 +28,11 @@ BENCH_TIME_BUDGET (s), BENCH_DEADLINE (s, whole-script soft deadline),
 BENCH_PROBE_TIMEOUT (s), BENCH_BATCH, BENCH_POINTS_CAP,
 BENCH_POINT_SCHEDULE ("nf32,nf64" aggressive point-class IPM schedule),
 BENCH_RESCUE (straggler re-solve iterations; see Oracle.rescue_iter) --
-the last two apply to the batched AND serial oracles alike, so speedups
-keep isolating batching.  BENCH_LARGE_DEPTH / BENCH_SHARDS size the
+those two apply to the batched AND serial oracles alike, so speedups
+keep isolating batching.  BENCH_TWO_PHASE=0/1, BENCH_PHASE1,
+BENCH_WARM=0/1 control the two-phase early-exit cohort and tree
+warm-starts (default ON; the serial baseline forces them off
+internally, staying the conservative fixed-schedule stand-in).  BENCH_LARGE_DEPTH / BENCH_SHARDS size the
 large-L synthetic export + sharded-serving metric (large_l_metrics;
 depth 0 disables it).
 
@@ -143,14 +146,20 @@ def deadline() -> float:
     return T_START + float(os.environ.get("BENCH_DEADLINE", "1500"))
 
 
-def probe_backend(timeout_s: float) -> str | None:
+def probe_backend(timeout_s: float, result: dict | None = None) -> str | None:
     """Default jax backend name, probed in a throwaway subprocess.
 
     A dead/hung TPU tunnel makes `import jax; jax.devices()` either raise
     (fast, handled) or hang in C code (unkillable in-process -- this is
     what voided round 1's capture).  The subprocess + timeout turns both
-    modes into a clean None."""
+    modes into a clean None.
+
+    On failure the WHY is recorded into `result["backend_probe_error"]`
+    (timeout, probe stderr tail, or the raised exception) so a
+    backend_probe_failed bench JSON is diagnosable after the fact
+    instead of a bare boolean."""
     code = "import jax; print('BACKEND=' + jax.default_backend())"
+    err = None
     try:
         out = subprocess.run([sys.executable, "-c", code],
                              capture_output=True, text=True,
@@ -158,12 +167,18 @@ def probe_backend(timeout_s: float) -> str | None:
         for line in out.stdout.splitlines():
             if line.startswith("BACKEND="):
                 return line.split("=", 1)[1].strip()
+        tail = out.stderr.strip().splitlines()[-3:]
+        err = f"probe rc={out.returncode}: " + " | ".join(tail)
         log(f"backend probe rc={out.returncode}: "
             f"{out.stderr.strip().splitlines()[-1:] or out.stderr!r}")
     except subprocess.TimeoutExpired:
+        err = f"probe timed out after {timeout_s:.0f}s"
         log(f"backend probe timed out after {timeout_s:.0f}s")
     except Exception as e:
+        err = repr(e)
         log(f"backend probe failed: {e!r}")
+    if result is not None and err is not None:
+        result["backend_probe_error"] = err[:500]
     return None
 
 
@@ -196,7 +211,7 @@ def choose_backend(result: dict | None = None,
         log(f"BENCH_PLATFORM={forced}: skipping probe")
     else:
         probe_to = float(os.environ.get("BENCH_PROBE_TIMEOUT", "180"))
-        chosen = probe_backend(probe_to)
+        chosen = probe_backend(probe_to, result)
         if chosen is None:
             log("device backend unreachable -> honest CPU fallback")
             result["backend_probe_failed"] = True
@@ -286,20 +301,41 @@ def schedule_kwargs(result: dict | None = None) -> dict:
     """Tuned-IPM-schedule env knobs, shared by bench and every capture
     script so a tune_schedule.json recommendation can be applied fleet-
     wide via environment: BENCH_POINT_SCHEDULE="nf32,nf64" (aggressive
-    point-class schedule) and BENCH_RESCUE="30" (straggler re-solve).
-    Unset = shipping defaults.  Records the knobs into `result`."""
+    point-class schedule), BENCH_RESCUE="30" (straggler re-solve),
+    BENCH_TWO_PHASE=0/1 (two-phase early-exit cohort; default ON),
+    BENCH_PHASE1 (phase-1 f64 iterations; default auto 2/5 split), and
+    BENCH_WARM=0/1 (tree warm-starts; default ON).  Unset = shipping
+    defaults.  Records env-overridden knobs into `result`.
+
+    The serial baseline oracle may receive these kwargs too: it forces
+    two_phase/warm_start OFF internally (Oracle.__init__), keeping the
+    vs_baseline estimate anchored to the conservative fixed-schedule
+    serial stand-in."""
     kw = {}
+    overrides = {}
     ps = os.environ.get("BENCH_POINT_SCHEDULE")
     if ps:
         a, b = ps.split(",")
         kw["point_schedule"] = (int(a), int(b))
+        overrides["point_schedule"] = [int(a), int(b)]
     r = os.environ.get("BENCH_RESCUE")
     if r and int(r) > 0:
         kw["rescue_iter"] = int(r)
-    if result is not None and kw:
-        result["schedule_overrides"] = {
-            k: list(v) if isinstance(v, tuple) else v
-            for k, v in kw.items()}
+        overrides["rescue_iter"] = int(r)
+    tp = os.environ.get("BENCH_TWO_PHASE")
+    kw["two_phase"] = tp != "0" if tp is not None else True
+    if tp is not None:
+        overrides["two_phase"] = kw["two_phase"]
+    p1 = os.environ.get("BENCH_PHASE1")
+    if p1:
+        kw["phase1_iters"] = int(p1)
+        overrides["phase1_iters"] = int(p1)
+    wm = os.environ.get("BENCH_WARM")
+    kw["warm_start"] = wm != "0" if wm is not None else True
+    if wm is not None:
+        overrides["warm_start"] = kw["warm_start"]
+    if result is not None and overrides:
+        result["schedule_overrides"] = overrides
     return kw
 
 
@@ -359,10 +395,17 @@ def warm_oracle(oracle, problem, stop_after: float | None = None) -> None:
         retry_transient(lambda: oracle.solve_vertices(pts),
                         what=f"warmup bucket {b}")
         b *= 2
-    # Sparse (point, delta) pair buckets -- the masked-vertex path
-    # (frontier._solve_missing skips ancestor-excluded commutations).
+    # Sparse (point, delta) pair buckets: the masked-vertex path, the
+    # tree-warm-start path, the phase-2 cohort finisher, and the rescue
+    # program all pad into this bucket family.  warm_pair_bucket
+    # compiles the EXACT program set the build dispatches (warm-capable
+    # phase-1 or legacy, + phase-2, + rescue) without counting solves.
+    # Two-phase/warm oracles need these buckets even at nd == 1: grid
+    # survivors compact into pair buckets.
     nd = problem.canonical.n_delta
-    if nd > 1:
+    if (nd > 1 or getattr(oracle, "two_phase", False)
+            or getattr(oracle, "warm_start", False)
+            or getattr(oracle, "rescue_iter", 0) > 0):
         b = 8
         while b <= oracle.max_pairs_per_call:
             if stop_after is not None and time.time() > stop_after:
@@ -372,23 +415,8 @@ def warm_oracle(oracle, problem, stop_after: float | None = None) -> None:
             pts = rng.uniform(problem.theta_lb, problem.theta_ub,
                               size=(b, problem.n_theta))
             ds = (np.arange(b, dtype=np.int64) % nd)
-            retry_transient(lambda: oracle.solve_pairs(pts, ds),
+            retry_transient(lambda: oracle.warm_pair_bucket(pts, ds),
                             what=f"pair warmup {b}")
-            b *= 2
-    # Rescue-program buckets (full-length cold-f64 re-solve of schedule
-    # stragglers): warmed only when enabled.
-    if getattr(oracle, "rescue_iter", 0) > 0:
-        b = 8
-        while b <= oracle.max_pairs_per_call:
-            if stop_after is not None and time.time() > stop_after:
-                log(f"warmup stopped early at rescue bucket {b}")
-                break
-            log(f"warmup: rescue bucket {b}")
-            pts = rng.uniform(problem.theta_lb, problem.theta_ub,
-                              size=(b, problem.n_theta))
-            ds = (np.arange(b, dtype=np.int64) % nd)
-            retry_transient(lambda: oracle._rescue_pairs(pts, ds),
-                            what=f"rescue warmup {b}")
             b *= 2
     # Simplex-query buckets: warm BOTH joint-QP programs directly at
     # every bucket (an unwarmed bucket is a ~minute mid-run tunnel
@@ -493,8 +521,7 @@ def run(result: dict, monitor: ContentionMonitor | None = None) -> None:
                                backend="device", batch_simplices=batch,
                                max_steps=50, time_budget_s=120.0)
     build_partition(problem, warm_cfg, oracle=oracle)
-    oracle.n_solves = oracle.n_point_solves = oracle.n_simplex_solves = 0
-    oracle.n_rescue_solves = 0
+    oracle.reset_stats()
 
     remaining = deadline() - time.time() - 90.0  # reserve for baseline
     budget = max(60.0, min(time_budget, remaining))
@@ -535,7 +562,28 @@ def run(result: dict, monitor: ContentionMonitor | None = None) -> None:
                   # Batches that fell back to the CPU twin mid-build (a
                   # flaky tunnel makes a 'tpu' number partially CPU-run;
                   # nonzero here flags that honestly).
-                  device_failures=stats["device_failures"])
+                  device_failures=stats["device_failures"],
+                  # Adaptive-work figures (two-phase cohort + tree
+                  # warm-starts): actual f64 IPM iterations vs what the
+                  # fixed single-phase schedule would have issued for
+                  # the same solves, and the derived rates.  The ISSUE-3
+                  # acceptance alternative (">= 25% reduction in total
+                  # f64 IPM iterations at equal region count") reads
+                  # exactly these two fields.
+                  two_phase=getattr(oracle, "two_phase", False),
+                  warm_start_tree=getattr(oracle, "warm_start", False),
+                  ipm_iters_f64=getattr(oracle, "n_iters_f64", None),
+                  ipm_iters_f64_fixed=getattr(oracle, "n_iters_f64_fixed",
+                                              None),
+                  ipm_iters_f32=getattr(oracle, "n_iters_f32", None),
+                  wasted_iter_frac=round(
+                      getattr(oracle, "wasted_iter_frac", 0.0), 4),
+                  phase2_survivor_frac=round(
+                      getattr(oracle, "phase2_survivor_frac", 0.0), 4),
+                  warmstart_accept_rate=round(
+                      getattr(oracle, "warmstart_accept_rate", 0.0), 4),
+                  compiled_shapes=len(
+                      getattr(oracle, "compiled_shapes", ())))
 
     # -- serial-oracle baseline estimate -----------------------------------
     # Point QPs and joint simplex QPs are structurally different sizes:

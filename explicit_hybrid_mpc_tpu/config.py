@@ -77,6 +77,27 @@ class PartitionConfig:
     # Full-length cold-f64 re-solve of feasible-but-unconverged point
     # solves (0 disables).  See Oracle(rescue_iter=...).
     ipm_rescue_iters: int = 0
+    # Two-phase early-exit cohort solve (Oracle(two_phase=...)): run a
+    # short first-phase f64 schedule on every point/elastic-simplex QP,
+    # read the converged mask on host, and finish only the unconverged
+    # survivors (compacted into a fresh power-of-two bucket) with the
+    # remaining iterations, warm-started from their own phase-1
+    # iterates through the kernel's merit gate.  Per-instance
+    # deterministic; the sound Farkas/phase-1 programs stay
+    # single-phase.  Ignored by backend='serial' (the conservative
+    # fixed-schedule baseline) and mesh-sharded oracles.
+    ipm_two_phase: bool = True
+    # f64 iterations in the cohort's first phase (clamped per program
+    # class to its f64 schedule length); None = 2/5 of the class
+    # schedule.
+    ipm_phase1_iters: Optional[int] = None
+    # Tree warm-starts (Oracle(warm_start=...)): cache the oracle's
+    # final duals/slacks per vertex row and feed a cached sibling
+    # vertex's iterates as the IPM start for new bisection midpoints,
+    # through the same merit gate (a bad donor falls back to the cold
+    # start, so certificates cannot degrade -- only iteration counts
+    # change).
+    warm_start_tree: bool = True
     # Dispatch the next frontier batch's point solves while the host
     # certifies the current batch (jax async dispatch; results consumed
     # next step).  Deterministic: the prefetched plan is exactly the plan
@@ -151,3 +172,6 @@ class PartitionConfig:
         if (self.semi_explicit_boundary_depth is not None
                 and self.semi_explicit_boundary_depth < 0):
             raise ValueError("semi_explicit_boundary_depth must be >= 0")
+        if self.ipm_phase1_iters is not None and self.ipm_phase1_iters < 1:
+            raise ValueError("ipm_phase1_iters must be >= 1 (or None for "
+                             "the automatic 2/5 split)")

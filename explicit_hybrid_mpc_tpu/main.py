@@ -56,6 +56,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--prune-rows", action="store_true",
                    help="prune never-active constraint rows with "
                    "KKT-verified per-solve fallback (row-heavy configs)")
+    p.add_argument("--no-two-phase", action="store_true",
+                   help="disable the two-phase early-exit IPM cohort "
+                   "(run the full fixed schedule on every QP)")
+    p.add_argument("--phase1-iters", type=int, default=None, metavar="N",
+                   help="f64 iterations in the cohort's first phase "
+                   "(default: 2/5 of each class's f64 schedule)")
+    p.add_argument("--no-warm-start", action="store_true",
+                   help="disable tree warm-starts (cold-start every "
+                   "child-vertex QP)")
     p.add_argument("--max-steps", type=int, default=10_000)
     p.add_argument("--checkpoint-every", type=int, default=0, metavar="K",
                    help="snapshot frontier+tree every K steps")
@@ -150,6 +159,9 @@ def main(argv: list[str] | None = None) -> int:
         batch_simplices=args.batch, max_depth=args.max_depth,
         semi_explicit_boundary_depth=args.boundary_depth,
         prune_rows=args.prune_rows,
+        ipm_two_phase=not args.no_two_phase,
+        ipm_phase1_iters=args.phase1_iters,
+        warm_start_tree=not args.no_warm_start,
         max_steps=args.max_steps,
         checkpoint_every=args.checkpoint_every,
         checkpoint_path=(f"{prefix}.ckpt.pkl"
@@ -183,9 +195,22 @@ def main(argv: list[str] | None = None) -> int:
         # semi_explicit_boundary_depth field has a plain class-level
         # default, so attribute lookup on old pickles already yields
         # None -- the feature stays off for resumed old builds.)
+        # Two-phase/warm-start knobs DO need a back-fill, and a
+        # conservative one: their class-level defaults are True (the
+        # new path), but a resumed pre-knob build must keep its
+        # original single-phase cold-start solver semantics mid-build
+        # (resumed-equals-straight parity) -- the class default would
+        # silently switch conv patterns at the resume point.
+        for fld, legacy in (("ipm_two_phase", False),
+                            ("ipm_phase1_iters", None),
+                            ("warm_start_tree", False)):
+            if fld not in snap_cfg.__dict__:
+                object.__setattr__(snap_cfg, fld, legacy)
         for fld in ("problem", "problem_args", "eps_a", "eps_r",
                     "algorithm", "backend", "precision",
                     "ipm_point_schedule", "ipm_rescue_iters",
+                    "ipm_two_phase", "ipm_phase1_iters",
+                    "warm_start_tree",
                     "batch_simplices", "max_depth",
                     "semi_explicit_boundary_depth", "prune_rows"):
             cli_v = getattr(cfg, fld)

@@ -11,8 +11,14 @@ fixed-iteration Mehrotra predictor-corrector* that solves thousands of
   large batched Cholesky/matmul work (SURVEY.md section 8 layer 2).
 - float64: IPMs are ill-conditioned near convergence (TPU emulates f64;
   correctness first -- SURVEY.md section 8 "hard parts" item 2).
-- No early exit: converged problems keep iterating harmlessly (steps go to
-  zero); a `converged` mask is computed from final residuals.
+- No PER-PROGRAM early exit: within one compiled program, converged
+  problems keep iterating harmlessly (steps go to zero); a `converged`
+  mask is computed from final residuals.  Adaptive WORK lives one level
+  up: the Oracle's two-phase cohort solve (oracle.Oracle, cfg.
+  ipm_two_phase) runs a short first-phase schedule, reads the mask on
+  host, and finishes only the unconverged survivors with the remaining
+  iterations via the merit-gated `warm_start` path below -- the kernel
+  itself stays fixed-shape and fixed-iteration.
 - Infeasible problems cannot converge in primal residual; they are
   classified by residual thresholds.  Decisions that must be SOUND
   (certifying a simplex empty, excluding a commutation from the V* lower
@@ -48,6 +54,11 @@ class QPSolution(NamedTuple):
     #                       warm start passed the f64 merit gate (False
     #                       when n_f32 == 0; the observable behind the
     #                       f32_accept_rate benchmark field)
+    warm_ok: jax.Array    # (...,) bool: a caller-supplied warm start
+    #                       (tree warm-start or two-phase continuation)
+    #                       passed the f64 merit gate (False when no
+    #                       warm start was supplied; the observable
+    #                       behind oracle.warmstart_accept_rate)
 
 
 _TINY = 1e-12
@@ -116,16 +127,30 @@ def schedule_iters(n_f32: int, n_f64: int) -> int:
     count.  This is the single definition behind the obs registry's
     `oracle.ipm_iters` counter (Oracle._obs_batch); the counter turns
     schedule changes (ipm_point_schedule, rescue_iter) into a visible
-    arithmetic-volume trend instead of an invisible knob."""
+    arithmetic-volume trend instead of an invisible knob.  Under the
+    two-phase cohort solve the counter stays exact by composition:
+    phase-1 schedule x all solves + phase-2 f64 length x survivors
+    (Oracle counts survivors on host at compaction time)."""
     return int(n_f32) + int(n_f64)
 
 
 def qp_solve(Q: jax.Array, q: jax.Array, A: jax.Array, b: jax.Array,
              n_iter: int = 30, tol: float = 1e-8,
-             n_f32: int = 0) -> QPSolution:
+             n_f32: int = 0,
+             warm_start: tuple | None = None) -> QPSolution:
     """Solve one dense convex QP with Mehrotra predictor-corrector.
 
     Shapes: Q (nz,nz) PD, q (nz,), A (nc,nz), b (nc,).  vmap freely.
+
+    warm_start, when given, is a ``(z0, s0, lam0, valid)`` tuple in
+    ORIGINAL (unequilibrated) units -- e.g. a neighbouring vertex's
+    returned iterates, or a two-phase continuation's own phase-1 result.
+    It is accepted only when ``valid`` is set AND its f64 KKT merit is no
+    worse than the cold start's (the same NaN-safe gate the f32 schedule
+    uses), so a bad warm start can never make the solve worse than cold:
+    the gate is the correctness argument for every warm-start producer.
+    When both a warm start and an f32 phase are configured, the gate runs
+    FIRST and the f32 phase then iterates from whichever start won.
 
     n_f32 > 0 enables the mixed-precision schedule (SURVEY.md section 8
     "hard parts" item 2): n_f32 iterations run in float32 -- native-speed
@@ -186,7 +211,31 @@ def qp_solve(Q: jax.Array, q: jax.Array, A: jax.Array, b: jax.Array,
     scale_p = 1.0 + jnp.max(jnp.abs(b))
     scale_d = 1.0 + jnp.max(jnp.abs(q))
 
+    def merit(carry):
+        """f64 KKT merit: max(scaled r_p, r_d, mu); NaN-safe (NaN
+        compares False, so a non-finite warm start is rejected)."""
+        zc, sc, lc = carry
+        sc = jnp.maximum(sc, _TINY)
+        lc = jnp.maximum(lc, _TINY)
+        mrp = jnp.max(jnp.abs(A @ zc + sc - b)) / scale_p
+        mrd = jnp.max(jnp.abs(Q @ zc + q + A.T @ lc)) / scale_d
+        mmu = jnp.dot(sc, lc) / nc / scale_d
+        return jnp.maximum(mrp, jnp.maximum(mrd, mmu))
+
     start = (z0, s0, lam0)
+    warm_ok = jnp.asarray(False)
+    if warm_start is not None:
+        zw, sw, lw, wvalid = warm_start
+        # Caller units -> the equilibrated space the iteration runs in
+        # (inverse of the unscaling applied to the returned solution).
+        warm = (jnp.asarray(zw, dtype) * dcol,
+                jnp.maximum(jnp.asarray(sw, dtype) / rown, _TINY),
+                jnp.maximum(jnp.asarray(lw, dtype) * rown, _TINY))
+        m_warm = merit(warm)
+        warm_ok = (jnp.asarray(wvalid) & jnp.isfinite(m_warm)
+                   & (m_warm <= merit(start)))
+        start = tuple(jnp.where(warm_ok, w, c)
+                      for w, c in zip(warm, start))
     f32_ok = jnp.asarray(False)
     if n_f32 > 0:
         f32 = jnp.float32
@@ -196,18 +245,6 @@ def qp_solve(Q: jax.Array, q: jax.Array, A: jax.Array, b: jax.Array,
             warm32 = jax.lax.fori_loop(
                 0, n_f32, body32, tuple(c.astype(f32) for c in start))
         warm = tuple(c.astype(dtype) for c in warm32)
-
-        def merit(carry):
-            """f64 KKT merit: max(scaled r_p, r_d, mu); NaN-safe (NaN
-            compares False, so a non-finite warm start is rejected)."""
-            zc, sc, lc = carry
-            sc = jnp.maximum(sc, _TINY)
-            lc = jnp.maximum(lc, _TINY)
-            mrp = jnp.max(jnp.abs(A @ zc + sc - b)) / scale_p
-            mrd = jnp.max(jnp.abs(Q @ zc + q + A.T @ lc)) / scale_d
-            mmu = jnp.dot(sc, lc) / nc / scale_d
-            return jnp.maximum(mrp, jnp.maximum(mrd, mmu))
-
         m_warm = merit(warm)
         ok = jnp.isfinite(m_warm) & (m_warm <= merit(start))
         f32_ok = ok
@@ -234,7 +271,8 @@ def qp_solve(Q: jax.Array, q: jax.Array, A: jax.Array, b: jax.Array,
     converged = finite & (r_p < tol) & (r_d < tol) & (gap < tol)
     feasible = finite & (r_p < jnp.sqrt(tol))
     return QPSolution(z=z, lam=lam, s=s, obj=obj, rp=r_p, rd=r_d, gap=gap,
-                      converged=converged, feasible=feasible, f32_ok=f32_ok)
+                      converged=converged, feasible=feasible, f32_ok=f32_ok,
+                      warm_ok=warm_ok)
 
 
 def phase1(A: jax.Array, b: jax.Array, n_iter: int = 30,

@@ -96,6 +96,10 @@ class VertexSolution(NamedTuple):
     #                      full sequences carries the certificate guarantee)
     Vstar: np.ndarray    # (P,) min over valid commutations; +inf if none
     dstar: np.ndarray    # (P,) argmin commutation; -1 if none valid
+    lam: np.ndarray | None = None  # (P, nd, nc) final duals -- populated
+    #                      only by two-phase / warm-start oracles (the
+    #                      tree warm-start donor data); None otherwise
+    s: np.ndarray | None = None    # (P, nd, nc) final slacks (ditto)
 
 
 def _solve_one(prob: DeviceProblem, theta: jax.Array, d: int, n_iter: int,
@@ -116,6 +120,26 @@ def _solve_one(prob: DeviceProblem, theta: jax.Array, d: int, n_iter: int,
     # (z holds v; the applied input is u = K x(theta) + v).
     u0 = prob.u_map[d] @ sol.z + prob.u_theta[d] @ theta + prob.u_const[d]
     return V, sol.converged, sol.feasible, grad, u0, sol.z
+
+
+def _solve_one_full(prob: DeviceProblem, theta: jax.Array, d,
+                    n_iter: int, n_f32: int = 0, warm=None):
+    """_solve_one plus the final duals/slacks and the warm-start accept
+    flag -- the wire format of the two-phase cohort and tree-warm-start
+    programs.  `warm` is an optional (z0, s0, lam0, valid) tuple in
+    original units, threaded to the kernel's merit-gated warm path."""
+    q = prob.f[d] + prob.F[d] @ theta
+    b = prob.w[d] + prob.S[d] @ theta
+    sol = ipm.qp_solve(prob.H[d], q, prob.G[d], b, n_iter=n_iter,
+                       n_f32=n_f32, warm_start=warm)
+    theta_cost = (0.5 * theta @ prob.Y[d] @ theta + prob.pvec[d] @ theta
+                  + prob.cconst[d])
+    V = sol.obj + theta_cost
+    grad = (prob.F[d].T @ sol.z + prob.Y[d] @ theta + prob.pvec[d]
+            - prob.S[d].T @ sol.lam)
+    u0 = prob.u_map[d] @ sol.z + prob.u_theta[d] @ theta + prob.u_const[d]
+    return (V, sol.converged, sol.feasible, grad, u0, sol.z, sol.lam,
+            sol.s, sol.rp, sol.warm_ok)
 
 
 def _solve_points_grid(prob: DeviceProblem, thetas: jax.Array, n_iter: int,
@@ -155,6 +179,26 @@ def _solve_points_all_deltas(prob: DeviceProblem, thetas: jax.Array,
                                                     n_f32)
     Vstar, dstar = reduce_deltas(V, conv)
     return V, conv, feas, grad, u0, z, Vstar, dstar
+
+
+def _solve_points_all_deltas_full(prob: DeviceProblem, thetas: jax.Array,
+                                  n_iter: int, n_f32: int = 0):
+    """Full-output grid solve: _solve_points_all_deltas plus the per-cell
+    duals/slacks appended (two-phase phase-1 and the tree-warm-start
+    donor rows both need them).  Kept as a SEPARATE program so the
+    legacy 8-output wire format (mesh sharding, SOC closures) is
+    untouched."""
+    nd = prob.H.shape[0]
+
+    def per_point(theta):
+        return jax.vmap(
+            lambda d: _solve_one_full(prob, theta, d, n_iter,
+                                      n_f32))(jnp.arange(nd))
+
+    V, conv, feas, grad, u0, z, lam, s, rp, _wok = \
+        jax.vmap(per_point)(thetas)
+    Vstar, dstar = reduce_deltas(V, conv)
+    return V, conv, feas, grad, u0, z, Vstar, dstar, lam, s, rp
 
 
 def _simplex_feas_one(prob: DeviceProblem, bary_M: jax.Array, d: int,
@@ -204,7 +248,8 @@ def _simplex_feas_one(prob: DeviceProblem, bary_M: jax.Array, d: int,
 
 def _solve_simplex_min_one(prob: DeviceProblem, bary_M: jax.Array,
                            d: int, n_iter: int, n_f32: int = 0,
-                           rho_elastic: float = 1e4):
+                           rho_elastic: float = 1e4, warm=None,
+                           full_out: bool = False):
     """Lower bound on min_{theta in R} V_delta(theta): ELASTIC joint QP
     over (z, theta, t).
 
@@ -257,7 +302,7 @@ def _solve_simplex_min_one(prob: DeviceProblem, bary_M: jax.Array,
     # (code-review r3).  rho=1e4 + tol=1e-9 keeps the absolute value
     # error ~1e-5, far below every config's eps.
     sol = ipm.qp_solve(Hj, qj, Gj, bj, n_iter=n_iter, n_f32=n_f32,
-                       tol=1e-9)
+                       tol=1e-9, warm_start=warm)
     # Clamp: the -t <= 0 row is only honored to the primal tolerance, and
     # a slightly NEGATIVE t would ADD rho*|t| to the reported bound --
     # the unsound direction for a lower bound.  Clamped, any solver error
@@ -273,6 +318,11 @@ def _solve_simplex_min_one(prob: DeviceProblem, bary_M: jax.Array,
     # (solve_simplex_min runs phase-1 only when t suggests otherwise).
     # The joint primal is returned so the pruned oracle can verify its
     # dropped rows at the witness (oracle/prune.py).
+    if full_out:
+        # Two-phase wire format: duals/slacks ride along so unconverged
+        # survivors can continue from their own phase-1 iterates.
+        return (obj + prob.cconst[d], sol.converged, sol.feasible,
+                t_elastic, sol.z, sol.lam, sol.s)
     return obj + prob.cconst[d], sol.converged, sol.feasible, t_elastic, \
         sol.z
 
@@ -287,6 +337,9 @@ class Oracle:
                  rescue_iter: int = 0,
                  point_schedule: tuple[int, int] | None = None,
                  stage2_order: str = "auto",
+                 two_phase: bool = False,
+                 phase1_iters: int | None = None,
+                 warm_start: bool = False,
                  obs: "obs_lib.Obs | None" = None):
         """mesh: optional jax.sharding.Mesh with ("batch", "delta") axes;
         when given, solve_vertices shards the (points x commutations) grid
@@ -299,7 +352,40 @@ class Oracle:
         remaining third as warm-started float64 polish, reaching the
         same 1e-8 KKT tolerance (ipm.qp_solve docstring; SURVEY.md
         section 8 "hard parts" item 2).  Both backends of a parity
-        comparison must use the SAME precision."""
+        comparison must use the SAME precision.
+
+        two_phase: adaptive-WORK cohort solve (cfg.ipm_two_phase).  The
+        point-class and elastic-simplex-min programs run a SHORT
+        first-phase f64 schedule (phase1_iters; default 2/5 of the
+        class's f64 length), the `converged` mask is read on host, and
+        only the unconverged survivors are compacted into a fresh
+        power-of-two bucket and finished with the remaining iterations,
+        warm-started from their own phase-1 iterates through the
+        kernel's merit gate.  Cells already DIVERGING after phase 1
+        (relative primal residual > _DIVERGED_RP) exit early instead --
+        conservative by direction: a hypothetical slow-feasible cell
+        above the threshold reports conv=False and at worst forces an
+        extra split, never an unsound certificate.  Per-instance
+        deterministic (each cell's result depends only on its own
+        iterates), so trees stay batch-composition-independent.  The SOUND single-shot programs
+        (joint phase-1/Farkas, point phase-1) keep their full
+        single-phase schedule: they return violation scalars with no
+        convergence flag to gate a continuation on.  Forced OFF for
+        backend='serial' (the conservative fixed-schedule baseline the
+        benchmarks estimate speedups against) and under a mesh (the
+        sharded grid solver has no cohort path).
+
+        phase1_iters: f64 iterations in the cohort's first phase
+        (clamped per class to its f64 length); None = 2/5 of the class
+        schedule.
+
+        warm_start: accept caller-supplied warm starts on the pair path
+        (dispatch_pairs(..., warm=...)) and return final duals/slacks
+        from the point-class programs so the frontier can cache them as
+        tree warm-start donors (cfg.warm_start_tree).  Correctness is
+        the kernel's merit gate: a bad warm start falls back to the
+        cold start, so certificates cannot degrade.  Forced OFF with
+        two_phase's exclusions."""
         self.problem = problem
         self.can = problem.canonical
         self.backend = backend
@@ -364,6 +450,61 @@ class Oracle:
                                  "need (n_f32 >= 0, n_f64 >= 1)")
         self.point_schedule = point_schedule
         self.mesh = mesh
+        # -- two-phase cohort + tree warm-starts (see __init__ doc) --------
+        if phase1_iters is not None and int(phase1_iters) < 1:
+            raise ValueError(f"phase1_iters={phase1_iters} must be >= 1")
+        self.phase1_iters = (None if phase1_iters is None
+                             else int(phase1_iters))
+        self.two_phase = bool(two_phase)
+        self.warm_start = bool(warm_start)
+        if backend == "serial" or mesh is not None:
+            # serial = the conservative fixed-schedule baseline; mesh =
+            # the sharded grid solver has no cohort/warm wire format.
+            self.two_phase = False
+            self.warm_start = False
+
+        def _split(n_f64: int) -> tuple[int, int]:
+            # Auto split: 2/5 of the class's f64 leg in phase 1.
+            # Measured on the tier-1 pendulum (mixed, warm-starts on):
+            # 2/5 (4 of 10) saves 27% of fixed f64 iterations vs 21%
+            # for 3/5 -- warm starts + the diverged-cell early exit
+            # keep the survivor set small enough that the shorter
+            # first leg pays.
+            p1 = min(n_f64, self.phase1_iters
+                     if self.phase1_iters is not None
+                     else max(1, (2 * n_f64) // 5))
+            return p1, n_f64 - p1
+        self.point_p1, self.point_p2 = _split(self.point_n_iter)
+        self.simplex_p1, self.simplex_p2 = _split(self.n_iter)
+        # Degenerate splits (phase1_iters >= class schedule) fall back to
+        # the single-phase path for that class.
+        self._point_cohort = self.two_phase and self.point_p2 > 0
+        self._simplex_cohort = self.two_phase and self.simplex_p2 > 0
+        # The full-output (10-slot) grid program is needed whenever the
+        # cohort must continue from phase-1 iterates OR the frontier
+        # wants duals/slacks cached as warm-start donors.
+        self._point_full_out = self._point_cohort or self.warm_start
+        # Iteration ledger (host ints, obs-independent): actual f32/f64
+        # IPM iterations issued vs the f64 iterations the single-phase
+        # fixed schedule would have issued for the same solves.  The
+        # exactness contract behind `oracle.ipm_iters` and the
+        # wasted_iter_frac benchmark field.
+        self.n_iters_f32 = 0
+        self.n_iters_f64 = 0
+        self.n_iters_f64_fixed = 0
+        # Cohort statistics: cells that entered a two-phase first pass
+        # and the survivors that needed the second.
+        self.n_tp_cells = 0
+        self.n_tp_survivors = 0
+        # Tree warm-start statistics (frontier-supplied warm starts
+        # through the merit gate).
+        self.n_warm_attempts = 0
+        self.n_warm_accepts = 0
+        # Distinct (program family, padded rows) shapes this oracle has
+        # dispatched -- the compiled-shape ledger behind the "warm
+        # shapes == run shapes" invariant (bench.warm_oracle and the
+        # guard test read it).
+        self.compiled_shapes: set[tuple[str, int]] = set()
         # Statistics: individual QP solves issued, split by kind -- the
         # point QPs (fixed-commutation solves at a parameter point) and
         # the joint simplex-wide QPs (min/phase-1 over (z, theta)), which
@@ -429,17 +570,58 @@ class Oracle:
                                            n_iter=self.point_n_iter,
                                            n_f32=self.point_n_f32)
 
-        self._solve_points = jax.jit(
-            functools.partial(_solve_points_all_deltas,
-                              n_iter=self.point_n_iter,
-                              n_f32=self.point_n_f32),
-            static_argnames=())
+        if self._point_full_out:
+            # Phase-1 grid program: short f64 leg under the cohort, full
+            # length under warm-start-only; either way the duals/slacks
+            # ride along (10 outputs instead of 8).
+            grid_p1 = (self.point_p1 if self._point_cohort
+                       else self.point_n_iter)
+            self._solve_points = jax.jit(
+                functools.partial(_solve_points_all_deltas_full,
+                                  n_iter=grid_p1,
+                                  n_f32=self.point_n_f32))
+            self._n_grid_out = 11
+            # Warm-capable pair phase-1: the frontier's tree-warm-start
+            # dispatch and the masked sparse path share this program.
+            self._solve_pairs_ws = jax.jit(jax.vmap(
+                lambda th, d, zw, sw, lw, hw: _solve_one_full(
+                    self.prob, th, d, grid_p1, self.point_n_f32,
+                    warm=(zw, sw, lw, hw)),
+                in_axes=(0, 0, 0, 0, 0, 0)))
+        else:
+            self._solve_points = jax.jit(
+                functools.partial(_solve_points_all_deltas,
+                                  n_iter=self.point_n_iter,
+                                  n_f32=self.point_n_f32),
+                static_argnames=())
+            self._n_grid_out = 8
+        if self._point_cohort:
+            # Phase-2 cohort finisher: pure-f64 remainder, warm-started
+            # from each survivor's own phase-1 iterates (merit-gated, so
+            # a diverged phase 1 restarts cold -- never worse than cold).
+            self._solve_pairs_p2 = jax.jit(jax.vmap(
+                lambda th, d, zw, sw, lw: _solve_one_full(
+                    self.prob, th, d, self.point_p2, 0,
+                    warm=(zw, sw, lw, True)),
+                in_axes=(0, 0, 0, 0, 0)))
         self._solve_one_point = jax.jit(
             lambda prob, theta: _solve_points_all_deltas(
                 prob, theta[None], self.point_n_iter, self.point_n_f32))
-        self._simplex_min = jax.jit(
-            jax.vmap(lambda M, d: _solve_simplex_min_one(
-                self.prob, M, d, self.n_iter, self.n_f32), in_axes=(0, 0)))
+        if self._simplex_cohort:
+            self._simplex_min = jax.jit(
+                jax.vmap(lambda M, d: _solve_simplex_min_one(
+                    self.prob, M, d, self.simplex_p1, self.n_f32,
+                    full_out=True), in_axes=(0, 0)))
+            self._simplex_min_p2 = jax.jit(
+                jax.vmap(lambda M, d, zw, sw, lw: _solve_simplex_min_one(
+                    self.prob, M, d, self.simplex_p2, 0,
+                    warm=(zw, sw, lw, True), full_out=True),
+                    in_axes=(0, 0, 0, 0, 0)))
+        else:
+            self._simplex_min = jax.jit(
+                jax.vmap(lambda M, d: _solve_simplex_min_one(
+                    self.prob, M, d, self.n_iter, self.n_f32),
+                    in_axes=(0, 0)))
         self._simplex_feas = jax.jit(
             jax.vmap(lambda M, d: _simplex_feas_one(
                 self.prob, M, d, self.n_iter, self.n_f32), in_axes=(0, 0)))
@@ -486,23 +668,107 @@ class Oracle:
             n_f32=(self.n_f32 if self.precision == "mixed" else None),
             points_cap=self.points_cap,
             rescue_iter=self.rescue_iter,
-            point_schedule=self.point_schedule)
+            point_schedule=self.point_schedule,
+            # Two-phase/warm-start semantics must mirror exactly: the
+            # twin re-solves FAILED batches and its per-cell results
+            # must be what the main oracle would have produced.
+            two_phase=self.two_phase,
+            phase1_iters=self.phase1_iters,
+            warm_start=self.warm_start)
+
+    # -- iteration ledger + metrics --------------------------------------
+
+    def _iters(self, f32: int, f64: int, f64_fixed: int) -> None:
+        """Record issued IPM iterations (and the f64 count the fixed
+        single-phase schedule would have issued) in the host ledger.
+        Every program-dispatch site calls this exactly once per batch;
+        the obs counters are derived from ledger deltas so the two can
+        never disagree."""
+        self.n_iters_f32 += int(f32)
+        self.n_iters_f64 += int(f64)
+        self.n_iters_f64_fixed += int(f64_fixed)
+
+    @property
+    def wasted_iter_frac(self) -> float:
+        """Fraction of the fixed schedule's f64 iterations the adaptive
+        two-phase path proved unnecessary: (fixed - actual) / fixed.
+        0.0 when two-phase is off or nothing has solved yet."""
+        fixed = self.n_iters_f64_fixed
+        return (fixed - self.n_iters_f64) / fixed if fixed else 0.0
+
+    @property
+    def phase2_survivor_frac(self) -> float:
+        """Fraction of two-phase cells still unconverged after phase 1
+        (the cohort the second pass actually ran on)."""
+        return (self.n_tp_survivors / self.n_tp_cells
+                if self.n_tp_cells else 0.0)
+
+    @property
+    def warmstart_accept_rate(self) -> float:
+        """Fraction of frontier-supplied tree warm starts that passed
+        the kernel's merit gate."""
+        return (self.n_warm_accepts / self.n_warm_attempts
+                if self.n_warm_attempts else 0.0)
+
+    # Every additive statistic a CPU-fallback retry must fold back into
+    # the main oracle (frontier._wait_or_fallback/_oracle_call): solve
+    # counts AND the iteration ledger/cohort/warm-start stats -- the
+    # documented-exact ipm_iters/wasted_iter_frac figures would
+    # otherwise silently drop every batch that hit a device failure.
+    _FOLD_STATS = ("n_solves", "n_point_solves", "n_simplex_solves",
+                   "n_rescue_solves", "n_iters_f32", "n_iters_f64",
+                   "n_iters_f64_fixed", "n_tp_cells", "n_tp_survivors",
+                   "n_warm_attempts", "n_warm_accepts")
+
+    def stat_snapshot(self) -> tuple:
+        """Current values of every foldable statistic (see _FOLD_STATS);
+        pair with fold_stats around a fallback-oracle retry."""
+        return tuple(getattr(self, k) for k in self._FOLD_STATS)
+
+    def fold_stats(self, other: "Oracle", before: tuple) -> None:
+        """Add the statistics `other` accumulated since `before` (its
+        stat_snapshot) into this oracle."""
+        for k, b in zip(self._FOLD_STATS, before):
+            setattr(self, k, getattr(self, k) + getattr(other, k) - b)
+
+    def reset_stats(self) -> None:
+        """Zero every solve/iteration counter (benchmarks call this
+        after warmup so compile-time work never pollutes the timed
+        figures).  The compiled-shape ledger is NOT reset: warm shapes
+        must remain visible to the shape-guard invariant."""
+        for k in self._FOLD_STATS:
+            setattr(self, k, 0)
+
+    def _note_shape(self, family: str, rows: int) -> None:
+        self.compiled_shapes.add((family, int(rows)))
 
     def _obs_batch(self, cls: str, n: int, wall: float,
-                   iters: int) -> None:
+                   iters_total: int, iters_f64: int | None = None) -> None:
         """Fold one batched device query into the metrics registry:
         per-QP blocking-wait latency (observed with weight n so the
         `oracle.<cls>_solve_s` histogram's quantiles stay per-solve
         figures even though QPs solve in batches) plus the
-        `oracle.ipm_iters` counter -- the kernel is fixed-iteration by
-        design (no early exit), so iterations = schedule length x
-        solves exactly (ipm.schedule_iters)."""
+        `oracle.ipm_iters` counter.  `iters_total` is the EXACT
+        iteration count of the batch: schedule length x solves on the
+        single-phase paths, phase-1 schedule x cells + phase-2 length x
+        survivors on the cohort paths (callers compute it from the host
+        ledger so the counter can never drift from the ledger)."""
         if not self.obs.enabled or n <= 0:
             return
         m = self.obs.metrics
         m.histogram(f"oracle.{cls}_solve_s").observe(wall / n, n=n)
         m.counter(f"oracle.{cls}_solves").inc(n)
-        m.counter("oracle.ipm_iters").inc(n * iters)
+        m.counter("oracle.ipm_iters").inc(int(iters_total))
+        if iters_f64 is not None:
+            m.counter("oracle.ipm_iters_f64").inc(int(iters_f64))
+        # Cumulative-rate gauges: cheap to recompute per batch, and a
+        # snapshot at any moment is the run-so-far figure.
+        m.gauge("oracle.wasted_iter_frac").set(self.wasted_iter_frac)
+        m.gauge("oracle.phase2_survivor_frac").set(
+            self.phase2_survivor_frac)
+        m.gauge("oracle.warmstart_accept_rate").set(
+            self.warmstart_accept_rate)
+        m.gauge("oracle.compiled_shapes").set(len(self.compiled_shapes))
 
     @staticmethod
     def _scaled_cond(H: np.ndarray) -> float:
@@ -570,6 +836,7 @@ class Oracle:
                 chunks.append((self._mesh_solver(chunk), Pc, False))
                 continue
             Ppad = min(cap, max(8, 1 << (Pc - 1).bit_length()))
+            self._note_shape("grid", Ppad)
             pad = np.zeros((Ppad - Pc, thetas.shape[1]))
             out = self._solve_points(self.prob, jnp.asarray(
                 np.concatenate([chunk, pad])))
@@ -590,6 +857,8 @@ class Oracle:
                 z=np.zeros((0, nd, nz)), Vstar=np.zeros(0),
                 dstar=np.zeros(0, dtype=np.int64))
         t0 = time.perf_counter()
+        lam = s = None
+        surv = 0
         if kind == "parts":
             _, thetas, parts = handle
         else:
@@ -597,22 +866,50 @@ class Oracle:
             parts = [np.concatenate(
                 [np.asarray(out[k])[:Pc] if padded else
                  np.asarray(out[k]) for out, Pc, padded in chunks])
-                for k in range(8)]
-        self._rescue_grid(thetas, parts)
+                for k in range(self._n_grid_out)]
+            if self._n_grid_out == 11:
+                lam, s, rp = parts[8], parts[9], parts[10]
+                parts = parts[:8]
+                if self._point_cohort:
+                    # Two-phase: compact the unconverged survivors and
+                    # finish only those with the remaining iterations.
+                    surv = self._phase2_grid(thetas, parts, lam, s, rp)
+        self._rescue_grid(thetas, parts, lam, s)
         # Counters last: if the transfer or the rescue raised, the caller
         # reroutes the WHOLE batch to the CPU fallback, whose own counts
         # are folded in -- counting here first would double-count it.
         n = thetas.shape[0] * self.can.n_delta
         self.n_solves += n
         self.n_point_solves += n
-        self._obs_batch("point", n, time.perf_counter() - t0,
-                        ipm.schedule_iters(self.point_n_f32,
-                                           self.point_n_iter))
-        return VertexSolution(*self._finalize(parts))
+        if self._point_full_out and kind == "chunks":
+            p1 = (self.point_p1 if self._point_cohort
+                  else self.point_n_iter)
+            f64 = n * p1 + surv * self.point_p2
+            if self._point_cohort:
+                self.n_tp_cells += n
+                self.n_tp_survivors += surv
+            self._iters(n * self.point_n_f32, f64, n * self.point_n_iter)
+            self._obs_batch("point", n, time.perf_counter() - t0,
+                            n * self.point_n_f32 + f64, f64)
+        else:
+            f64 = n * self.point_n_iter
+            self._iters(n * self.point_n_f32, f64, f64)
+            self._obs_batch("point", n, time.perf_counter() - t0,
+                            n * ipm.schedule_iters(self.point_n_f32,
+                                                   self.point_n_iter),
+                            f64)
+        return VertexSolution(*self._finalize(parts), lam=lam, s=s)
 
-    def _rescue_grid(self, thetas: np.ndarray, parts: list) -> None:
+    def _rescue_grid(self, thetas: np.ndarray, parts: list,
+                     lam: np.ndarray | None = None,
+                     s: np.ndarray | None = None) -> None:
         """Re-solve feasible-but-unconverged grid cells in place (the
-        rescue pass; no-op when rescue_iter == 0 or nothing qualifies)."""
+        rescue pass; no-op when rescue_iter == 0 or nothing qualifies).
+        The rescue program does not return duals, so rescued cells'
+        lam/s donor slots are invalidated with NaN -- caching the
+        pre-rescue duals against the rescued primal would offer the
+        frontier an inconsistent warm start the merit gate then rejects
+        anyway (a silent warm-start hit-rate hole)."""
         if self.rescue_iter <= 0:
             return
         V, conv, feas, grad, u0, z, Vstar, dstar = parts
@@ -627,6 +924,9 @@ class Oracle:
         grad[pt, ds] = rgrad
         u0[pt, ds] = ru0
         z[pt, ds] = rz
+        if lam is not None:
+            lam[pt, ds] = np.nan
+            s[pt, ds] = np.nan
         # Re-reduce the touched points (same first-minimum tie-break as
         # reduce_deltas).
         for p in np.unique(pt):
@@ -655,24 +955,133 @@ class Oracle:
             chunks = []
             for lo in range(0, K, cap):
                 tj, dj, Kc = self._pad_pairs(thetas[lo:lo + cap],
-                                             ds[lo:lo + cap])
+                                             ds[lo:lo + cap],
+                                             family="rescue")
                 out = self._solve_rescue(tj, dj)
                 chunks.append([np.asarray(o)[:Kc] for o in out])
             parts = [np.concatenate([c[k] for c in chunks])
                      for k in range(6)]
-        self._obs_batch("rescue", K, time.perf_counter() - t0,
-                        ipm.schedule_iters(0, self.rescue_iter))
+        f64 = K * self.rescue_iter
+        self._iters(0, f64, f64)
+        self._obs_batch("rescue", K, time.perf_counter() - t0, f64, f64)
         return parts
 
-    def _pad_pairs(self, thetas: np.ndarray, ds: np.ndarray):
-        """Pad a (point, delta) pair batch to its power-of-two bucket."""
+    def _pad_pairs(self, thetas: np.ndarray, ds: np.ndarray,
+                   family: str = "pairs"):
+        """Pad a (point, delta) pair batch to its power-of-two bucket.
+        `family` names the program the batch feeds (pairs / rescue /
+        pairs_p2 / ...) for the compiled-shape ledger."""
         Kc = thetas.shape[0]
         Kpad = max(8, min(self.max_pairs_per_call,
                           1 << (Kc - 1).bit_length()))
+        self._note_shape(family, Kpad)
         tpad = np.concatenate(
             [thetas, np.zeros((Kpad - Kc, thetas.shape[1]))])
         dpad = np.concatenate([ds, np.zeros(Kpad - Kc, dtype=np.int64)])
         return jnp.asarray(tpad), jnp.asarray(dpad), Kc
+
+    # -- two-phase cohort (point class) ------------------------------------
+
+    @staticmethod
+    def _pad_warm(arrs, lo: int, hi: int, n_pad: int):
+        """Zero-pad slices of per-cell warm arrays to a padded bucket
+        (the one padding rule shared by the ws dispatch, the point and
+        simplex phase-2 finishers, and warmup -- it must track
+        _pad_pairs/_pad_simplex)."""
+        return [jnp.asarray(np.concatenate(
+            [a[lo:hi], np.zeros((n_pad,) + a.shape[1:], dtype=a.dtype)]))
+            for a in arrs]
+
+    def _solve_p2_cells(self, thetas: np.ndarray, ds: np.ndarray,
+                        zw: np.ndarray, sw: np.ndarray, lw: np.ndarray):
+        """Chunked+padded phase-2 finisher over (point, delta) survivor
+        cells, warm-started from their own phase-1 iterates (merit-
+        gated: a diverged phase 1 restarts cold).  Returns the 8 result
+        arrays (V, conv, feas, grad, u0, z, lam, s) truncated to K."""
+        K = thetas.shape[0]
+        cap = self.max_pairs_per_call
+        outs = []
+        for lo in range(0, K, cap):
+            tj, dj, Kc = self._pad_pairs(thetas[lo:lo + cap],
+                                         ds[lo:lo + cap],
+                                         family="pairs_p2")
+            zj, sj, lj = self._pad_warm((zw, sw, lw), lo, lo + cap,
+                                        tj.shape[0] - Kc)
+            out = self._solve_pairs_p2(tj, dj, zj, sj, lj)
+            outs.append([np.asarray(o)[:Kc] for o in out[:8]])
+        return [np.concatenate([c[k] for c in outs]) for k in range(8)]
+
+    def warm_pair_bucket(self, thetas: np.ndarray, ds: np.ndarray) -> None:
+        """Compile every pair-class program (phase-1 -- warm-capable or
+        legacy -- plus the phase-2 cohort finisher when enabled) at the
+        padded bucket of `thetas` without counting solves.  Benchmark
+        warmup must hit the EXACT program set the build dispatches: the
+        cohort re-pads survivors into the same {8..cap} bucket family,
+        so one zero-warm call per bucket covers phase 2 too."""
+        thetas = np.atleast_2d(np.asarray(thetas, dtype=np.float64))
+        ds = np.asarray(ds, dtype=np.int64)
+        tj, dj, _Kc = self._pad_pairs(thetas, ds)
+        K = int(tj.shape[0])
+        nz, nc = self.can.nz, self.can.nc
+        if self._point_full_out:
+            self._solve_pairs_ws(
+                tj, dj, jnp.zeros((K, nz)), jnp.zeros((K, nc)),
+                jnp.zeros((K, nc)), jnp.zeros(K, dtype=bool))
+        else:
+            self._solve_fixed(tj, dj)
+        if self._point_cohort:
+            self._note_shape("pairs_p2", K)
+            self._solve_pairs_p2(
+                tj, dj, jnp.zeros((K, nz)), jnp.zeros((K, nc)),
+                jnp.zeros((K, nc)))
+        if self.rescue_iter > 0:
+            self._note_shape("rescue", K)
+            self._solve_rescue(tj, dj)
+
+    # Diverged-cell early exit: an unconverged phase-1 cell whose
+    # relative primal residual is still above this (100x the 1e-4
+    # feasibility threshold, after >= phase-1's full f32+f64 leg) is an
+    # infeasible QP diverging -- the remaining schedule cannot converge
+    # it and would only refine the violation estimate.  Skipping it
+    # keeps conv=False/feas=False exactly as the full schedule would
+    # report; only cells in the (1e-4, 1e-2] knife-edge band stay in
+    # the cohort to protect the rescue pass's feas flag.
+    _DIVERGED_RP = 1e-2
+
+    def _tp_survivors(self, conv, rp):
+        """Indices of cells that continue into phase 2."""
+        return np.nonzero(~np.asarray(conv, dtype=bool)
+                          & np.isfinite(rp) & (rp <= self._DIVERGED_RP))
+
+    def _phase2_grid(self, thetas: np.ndarray, parts: list,
+                     lam: np.ndarray, s: np.ndarray,
+                     rp: np.ndarray) -> int:
+        """Finish the unconverged, non-diverged survivors of a phase-1
+        grid solve in place.  Updates `parts` AND the lam/s donor
+        arrays; returns the survivor count."""
+        V, conv, feas, grad, u0, z, Vstar, dstar = parts
+        pt, ds = self._tp_survivors(conv, rp)
+        if pt.size == 0:
+            return 0
+        rV, rconv, rfeas, rgrad, ru0, rz, rlam, rs = self._solve_p2_cells(
+            thetas[pt], ds.astype(np.int64), z[pt, ds], s[pt, ds],
+            lam[pt, ds])
+        V[pt, ds] = rV
+        conv[pt, ds] = rconv
+        feas[pt, ds] = rfeas
+        grad[pt, ds] = rgrad
+        u0[pt, ds] = ru0
+        z[pt, ds] = rz
+        lam[pt, ds] = rlam
+        s[pt, ds] = rs
+        # Re-reduce the touched points (same first-minimum tie-break as
+        # reduce_deltas).
+        for p in np.unique(pt):
+            Vval = np.where(conv[p], V[p], _INF)
+            j = int(np.argmin(Vval))
+            Vstar[p] = Vval[j]
+            dstar[p] = j if np.isfinite(Vval[j]) else -1
+        return int(pt.size)
 
     @staticmethod
     def _finalize(parts):
@@ -702,9 +1111,11 @@ class Oracle:
         return max(8, min(self.max_simplex_rows_per_call,
                           1 << (K - 1).bit_length()))
 
-    def _pad_simplex(self, Ms: np.ndarray, ds: np.ndarray):
+    def _pad_simplex(self, Ms: np.ndarray, ds: np.ndarray,
+                     family: str = "simplex_min"):
         K = Ms.shape[0]
         Kpad = self.simplex_bucket(K)
+        self._note_shape(family, Kpad)
         Mpad = np.concatenate(
             [Ms, np.tile(np.eye(Ms.shape[1])[None], (Kpad - K, 1, 1))])
         dpad = np.concatenate([ds, np.zeros(Kpad - K, dtype=np.int64)])
@@ -747,6 +1158,8 @@ class Oracle:
             return np.zeros(0), np.zeros(0, dtype=bool)
         t0 = time.perf_counter()
         n_before = self.n_solves
+        it0 = self.n_iters_f32 + self.n_iters_f64
+        f64_0 = self.n_iters_f64
         cap = self.max_simplex_rows_per_call
         outs, feas_sw = [], []
         for lo in range(0, K, cap):
@@ -782,9 +1195,13 @@ class Oracle:
             feas_sw.append(feasible_somewhere)
         # n = QPs actually issued (solve-order-dependent: phase-1 rows
         # skipped by the elastic witness, and vice versa, never ran).
+        # Iteration totals come from the host-ledger delta across the
+        # call -- the elastic cohort and the single-phase Farkas pass
+        # each folded their exact counts in at dispatch time.
         self._obs_batch("simplex", self.n_solves - n_before,
                         time.perf_counter() - t0,
-                        ipm.schedule_iters(self.n_f32, self.n_iter))
+                        self.n_iters_f32 + self.n_iters_f64 - it0,
+                        self.n_iters_f64 - f64_0)
         return np.concatenate(outs), np.concatenate(feas_sw)
 
     def _elastic_min_into(self, Ms: np.ndarray, ds: np.ndarray,
@@ -797,13 +1214,46 @@ class Oracle:
         tolerance live in exactly one place."""
         if idx.size == 0:
             return
-        self.n_solves += idx.size
-        self.n_simplex_solves += idx.size
-        Mj, dj = self._pad_simplex(Ms[idx], ds[idx])
-        V, conv, _feas, t_el, _zj = self._simplex_min(Mj, dj)
-        V = np.asarray(V)[:idx.size]
-        conv = np.asarray(conv)[:idx.size]
-        t_el = np.asarray(t_el)[:idx.size]
+        n = idx.size
+        self.n_solves += n
+        self.n_simplex_solves += n
+        Mj, dj = self._pad_simplex(Ms[idx], ds[idx], family="simplex_min")
+        if self._simplex_cohort:
+            # Two-phase: short first leg on every row, host-read of the
+            # converged mask, warm-started finisher on the survivors
+            # only.  Classification semantics are unchanged -- survivors
+            # receive exactly the remaining schedule, so a row's final
+            # (conv, V, t_el) depends only on its own iterates.
+            V, conv, _feas, t_el, zj, lamj, sj = self._simplex_min(Mj, dj)
+            V = np.asarray(V)[:n].copy()
+            conv = np.asarray(conv)[:n].astype(bool)
+            t_el = np.asarray(t_el)[:n].copy()
+            surv = np.nonzero(~conv)[0]
+            self.n_tp_cells += n
+            self.n_tp_survivors += surv.size
+            self._iters(n * self.n_f32,
+                        n * self.simplex_p1 + surv.size * self.simplex_p2,
+                        n * self.n_iter)
+            if surv.size:
+                zj = np.asarray(zj)[:n]
+                lamj = np.asarray(lamj)[:n]
+                sj = np.asarray(sj)[:n]
+                Mj2, dj2 = self._pad_simplex(Ms[idx[surv]], ds[idx[surv]],
+                                             family="simplex_p2")
+                z2, s2, l2 = self._pad_warm(
+                    (zj[surv], sj[surv], lamj[surv]), 0, surv.size,
+                    Mj2.shape[0] - surv.size)
+                V2, conv2, _f2, t2, _z2, _l2, _s2 = self._simplex_min_p2(
+                    Mj2, dj2, z2, s2, l2)
+                V[surv] = np.asarray(V2)[:surv.size]
+                conv[surv] = np.asarray(conv2)[:surv.size]
+                t_el[surv] = np.asarray(t2)[:surv.size]
+        else:
+            V, conv, _feas, t_el, _zj = self._simplex_min(Mj, dj)
+            V = np.asarray(V)[:n]
+            conv = np.asarray(conv)[:n].astype(bool)
+            t_el = np.asarray(t_el)[:n]
+            self._iters(n * self.n_f32, n * self.n_iter, n * self.n_iter)
         out[idx] = np.where(conv, V, -_INF)
         feasible_somewhere[idx] |= conv & (t_el <= 1e-6)
 
@@ -815,9 +1265,22 @@ class Oracle:
         data-dependent subset, and the invariant "warm shapes == run
         shapes" belongs inside Oracle, next to the padding scheme."""
         Mj, dj = self._pad_simplex(np.asarray(Ms),
-                                   np.asarray(ds, dtype=np.int64))
+                                   np.asarray(ds, dtype=np.int64),
+                                   family="simplex_min")
+        self._note_shape("simplex_feas", Mj.shape[0])
         self._simplex_min(Mj, dj)
         self._simplex_feas(Mj, dj)
+        if self._simplex_cohort:
+            # Phase-2 cohort buckets compile at the SAME padded sizes
+            # (survivor compaction re-pads into the {8..cap} set), so
+            # one zero-warm call per bucket covers them.
+            self._note_shape("simplex_p2", Mj.shape[0])
+            K = int(Mj.shape[0])
+            dim_z = self.can.nz + self.can.n_theta + 1
+            dim_c = self.can.nc + int(Mj.shape[1]) + 1
+            self._simplex_min_p2(
+                Mj, dj, jnp.zeros((K, dim_z)), jnp.zeros((K, dim_c)),
+                jnp.zeros((K, dim_c)))
 
     def _run_simplex_feas(self, Ms: np.ndarray, ds: np.ndarray
                           ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
@@ -828,12 +1291,16 @@ class Oracle:
         cap = self.max_simplex_rows_per_call
         ts, convs, fks = [], [], []
         for lo in range(0, K, cap):
-            Mj, dj = self._pad_simplex(Ms[lo:lo + cap], ds[lo:lo + cap])
+            Mj, dj = self._pad_simplex(Ms[lo:lo + cap], ds[lo:lo + cap],
+                                       family="simplex_feas")
             Kc = min(cap, K - lo)
             t, conv, farkas = self._simplex_feas(Mj, dj)
             ts.append(np.asarray(t)[:Kc])
             convs.append(np.asarray(conv)[:Kc])
             fks.append(np.asarray(farkas)[:Kc])
+        # The sound Farkas/phase-1 program is single-phase by design:
+        # fixed == actual.
+        self._iters(K * self.n_f32, K * self.n_iter, K * self.n_iter)
         return np.concatenate(ts), np.concatenate(convs), np.concatenate(fks)
 
     def simplex_feasibility(self, bary_Ms: np.ndarray,
@@ -856,8 +1323,9 @@ class Oracle:
         delta_idx = np.asarray(delta_idx, dtype=np.int64)
         t0 = time.perf_counter()
         t, conv, farkas = self._run_simplex_feas(bary_Ms, delta_idx)
+        it = K * ipm.schedule_iters(self.n_f32, self.n_iter)
         self._obs_batch("simplex", K, time.perf_counter() - t0,
-                        ipm.schedule_iters(self.n_f32, self.n_iter))
+                        it, K * self.n_iter)
         return t, conv & (t <= 1e-6), conv & (t > 1e-6) & farkas
 
     # -- fixed-commutation (point, delta) pair solves ----------------------
@@ -889,9 +1357,26 @@ class Oracle:
         """
         return self.wait_pairs(self.dispatch_pairs(thetas, delta_idx))
 
-    def dispatch_pairs(self, thetas: np.ndarray, delta_idx: np.ndarray):
+    def solve_pairs_full(self, thetas: np.ndarray, delta_idx: np.ndarray,
+                         warm=None):
+        """solve_pairs plus the final duals/slacks appended (the tree-
+        warm-start wire: the frontier caches (lam, s) as donor rows for
+        child-vertex dispatch).  lam/s are None on oracles without the
+        full-output programs."""
+        return self.wait_pairs_full(
+            self.dispatch_pairs(thetas, delta_idx, warm=warm))
+
+    def dispatch_pairs(self, thetas: np.ndarray, delta_idx: np.ndarray,
+                       warm=None):
         """Non-blocking counterpart of solve_pairs (see
-        dispatch_vertices)."""
+        dispatch_vertices).
+
+        warm: optional (z0 (K,nz), s0 (K,nc), lam0 (K,nc), has (K,))
+        tree-warm-start donor arrays aligned with the pair batch.  Each
+        cell's start goes through the kernel's merit gate (valid only
+        where `has` is set), so a stale or bad donor is merit-
+        equivalent to a cold start.  Ignored on oracles without the
+        warm-capable programs (legacy / serial / mesh)."""
         thetas = np.atleast_2d(np.asarray(thetas, dtype=np.float64))
         K = thetas.shape[0]
         if K == 0:
@@ -906,6 +1391,23 @@ class Oracle:
             return ("parts", thetas, delta_idx, parts)
         cap = self.max_pairs_per_call
         chunks = []
+        if self._point_full_out:
+            nz, nc = self.can.nz, self.can.nc
+            if warm is None:
+                zw = np.zeros((K, nz))
+                sw = np.zeros((K, nc))
+                lw = np.zeros((K, nc))
+                hw = np.zeros(K, dtype=bool)
+            else:
+                zw, sw, lw, hw = warm
+            for lo in range(0, K, cap):
+                tj, dj, Kc = self._pad_pairs(thetas[lo:lo + cap],
+                                             delta_idx[lo:lo + cap])
+                zj, sj, lj, hj = self._pad_warm(
+                    (zw, sw, lw, hw), lo, lo + cap, tj.shape[0] - Kc)
+                chunks.append(
+                    (self._solve_pairs_ws(tj, dj, zj, sj, lj, hj), Kc))
+            return ("ws_chunks", thetas, delta_idx, chunks, hw)
         for lo in range(0, K, cap):
             tj, dj, Kc = self._pad_pairs(thetas[lo:lo + cap],
                                          delta_idx[lo:lo + cap])
@@ -913,13 +1415,69 @@ class Oracle:
         return ("chunks", thetas, delta_idx, chunks)
 
     def wait_pairs(self, handle):
-        """Block on a dispatch_pairs handle: transfer, rescue, finalize."""
+        """Block on a dispatch_pairs handle: transfer, cohort phase 2,
+        rescue, finalize."""
+        return self.wait_pairs_full(handle)[:5]
+
+    def wait_pairs_full(self, handle):
+        """wait_pairs returning (V, conv, grad, u0, z, lam, s); lam/s
+        are the final duals/slacks on full-output paths, None on the
+        legacy ones."""
         kind = handle[0]
         if kind == "empty":
             nt, nu, nz = self.can.n_theta, self.can.n_u, self.can.nz
             return (np.zeros(0), np.zeros(0, dtype=bool), np.zeros((0, nt)),
-                    np.zeros((0, nu)), np.zeros((0, nz)))
+                    np.zeros((0, nu)), np.zeros((0, nz)), None, None)
         t0 = time.perf_counter()
+        if kind == "ws_chunks":
+            _, thetas, delta_idx, chunks, hw = handle
+            parts = [np.concatenate([np.asarray(out[k])[:Kc]
+                                     for out, Kc in chunks])
+                     for k in range(10)]
+            V, conv, feas, grad, u0, z, lam, s, rp, wok = parts
+            conv, feas = conv.astype(bool), feas.astype(bool)
+            K = thetas.shape[0]
+            surv = 0
+            if self._point_cohort:
+                (sidx,) = self._tp_survivors(conv, rp)
+                surv = sidx.size
+            if surv:
+                rV, rconv, rfeas, rgrad, ru0, rz, rlam, rs = \
+                    self._solve_p2_cells(thetas[sidx], delta_idx[sidx],
+                                         z[sidx], s[sidx], lam[sidx])
+                V[sidx], conv[sidx], feas[sidx] = rV, rconv, rfeas
+                grad[sidx], u0[sidx], z[sidx] = rgrad, ru0, rz
+                lam[sidx], s[sidx] = rlam, rs
+            if self.rescue_iter > 0 and np.any(feas & ~conv):
+                idx = np.nonzero(feas & ~conv)[0]
+                rV, rconv, _rfeas, rgrad, ru0, rz = self._rescue_pairs(
+                    thetas[idx], delta_idx[idx])
+                V[idx], conv[idx], grad[idx] = rV, rconv, rgrad
+                u0[idx], z[idx] = ru0, rz
+                # No duals from the rescue program: invalidate the
+                # donor slots (see _rescue_grid).
+                lam[idx] = np.nan
+                s[idx] = np.nan
+            # Counters last (see wait_vertices) -- including the warm
+            # ledger: a phase-2/rescue failure reroutes the WHOLE batch
+            # to the CPU twin whose fold_stats would otherwise add this
+            # batch's warm attempts a second time.
+            n_att = int(hw.sum())
+            if n_att:
+                self.n_warm_attempts += n_att
+                self.n_warm_accepts += int(wok.astype(bool)[hw].sum())
+            self.n_solves += K
+            self.n_point_solves += K
+            p1 = (self.point_p1 if self._point_cohort
+                  else self.point_n_iter)
+            f64 = K * p1 + surv * self.point_p2
+            if self._point_cohort:
+                self.n_tp_cells += K
+                self.n_tp_survivors += surv
+            self._iters(K * self.point_n_f32, f64, K * self.point_n_iter)
+            self._obs_batch("point", K, time.perf_counter() - t0,
+                            K * self.point_n_f32 + f64, f64)
+            return np.where(conv, V, _INF), conv, grad, u0, z, lam, s
         if kind == "parts":
             _, thetas, delta_idx, parts = handle
         else:
@@ -936,13 +1494,15 @@ class Oracle:
             V[idx], conv[idx], grad[idx] = rV, rconv, rgrad
             u0[idx], z[idx] = ru0, rz
         # Counters last (see wait_vertices).
-        self.n_solves += thetas.shape[0]
-        self.n_point_solves += thetas.shape[0]
-        self._obs_batch("point", thetas.shape[0],
-                        time.perf_counter() - t0,
-                        ipm.schedule_iters(self.point_n_f32,
-                                           self.point_n_iter))
-        return np.where(conv, V, _INF), conv, grad, u0, z
+        K = thetas.shape[0]
+        self.n_solves += K
+        self.n_point_solves += K
+        f64 = K * self.point_n_iter
+        self._iters(K * self.point_n_f32, f64, f64)
+        self._obs_batch("point", K, time.perf_counter() - t0,
+                        K * ipm.schedule_iters(self.point_n_f32,
+                                               self.point_n_iter), f64)
+        return np.where(conv, V, _INF), conv, grad, u0, z, None, None
 
     # -- fixed-commutation point solve (the semi-explicit ONLINE stage) ----
 
@@ -979,9 +1539,12 @@ class Oracle:
         K = thetas.shape[0]
         self.n_solves += K
         Kpad = max(8, 1 << (K - 1).bit_length())
+        self._note_shape("point_feas", Kpad)
         tpad = np.concatenate(
             [thetas, np.zeros((Kpad - K, thetas.shape[1]))])
         dpad = np.concatenate([np.asarray(delta_idx, dtype=np.int64),
                                np.zeros(Kpad - K, dtype=np.int64)])
         t = self._point_feas(jnp.asarray(tpad), jnp.asarray(dpad))
+        # Point phase-1 keeps the sound full single-phase schedule.
+        self._iters(K * self.n_f32, K * self.n_iter, K * self.n_iter)
         return np.asarray(t)[:K]

@@ -140,6 +140,13 @@ class PrunedOracle(Oracle):
         if kw.get("backend") == "serial" or kw.get("mesh") is not None:
             raise ValueError("PrunedOracle supports batched single-device "
                              "backends only")
+        # The reduced program set has no two-phase cohort or warm-start
+        # variants (pruning already adapts per-instance work through the
+        # verified fallback); forcing the knobs off keeps the pruned
+        # paths single-phase and the frontier from offering warm data
+        # this oracle cannot consume.
+        kw["two_phase"] = False
+        kw["warm_start"] = False
         super().__init__(problem, **kw)
         can = self.can
         row_keep = activity_masks(self, problem, n_samples=n_samples,
@@ -222,6 +229,19 @@ class PrunedOracle(Oracle):
 
     # -- helpers -----------------------------------------------------------
 
+    def warm_pair_bucket(self, thetas: np.ndarray, ds: np.ndarray) -> None:
+        """Compile the reduced pair program and its phase-1 gate at this
+        bucket too: the base method covers only the full-problem
+        programs (the verified-fallback path), while the hot path runs
+        reduced."""
+        super().warm_pair_bucket(thetas, ds)
+        if hasattr(self, "_red_dev"):
+            thetas = np.atleast_2d(np.asarray(thetas, dtype=np.float64))
+            tj, dj, _Kc = self._pad_pairs(
+                thetas, np.asarray(ds, dtype=np.int64), family="pairs_red")
+            self._solve_pairs_red(tj, dj)
+            self._point_feas_red(tj, dj)
+
     def _scatter_z(self, z_red: np.ndarray, ds: np.ndarray) -> np.ndarray:
         """(..., nzr) reduced primal -> (..., nz) full primal with
         dropped vars at 0.  ds broadcasts over the leading axes."""
@@ -266,6 +286,7 @@ class PrunedOracle(Oracle):
             chunk = thetas[lo:lo + cap]
             Pc = chunk.shape[0]
             Ppad = min(cap, max(8, 1 << (Pc - 1).bit_length()))
+            self._note_shape("grid_red", Ppad)
             pad = np.zeros((Ppad - Pc, thetas.shape[1]))
             out = self._solve_points(self._red_dev, jnp.asarray(
                 np.concatenate([chunk, pad])))
@@ -292,10 +313,12 @@ class PrunedOracle(Oracle):
         self.n_prune_fallbacks += n_fb
         self.n_solves += P * nd + n_fb + n_gate
         self.n_point_solves += P * nd + n_fb
-        self._obs_batch("point", P * nd + n_fb,
-                        time.perf_counter() - t0,
-                        ipm.schedule_iters(self.point_n_f32,
-                                           self.point_n_iter))
+        n = P * nd + n_fb
+        f32 = n * self.point_n_f32 + n_gate * self.n_f32
+        f64 = n * self.point_n_iter + n_gate * self.n_iter
+        self._iters(f32, f64, f64)
+        self._obs_batch("point", n, time.perf_counter() - t0,
+                        f32 + f64, f64)
         self._obs_prune(n_fb, n_gate)
         return VertexSolution(*self._finalize(parts))
 
@@ -328,8 +351,11 @@ class PrunedOracle(Oracle):
         need = np.empty(K, dtype=bool)
         cap = self.max_pairs_per_call
         for lo in range(0, K, cap):
+            # Same "pairs_red" ledger family as the reduced pair solve:
+            # warm_pair_bucket warms both reduced programs per bucket.
             tj, dj, Kc = self._pad_pairs(thetas[lo:lo + cap],
-                                         ds[lo:lo + cap].astype(np.int64))
+                                         ds[lo:lo + cap].astype(np.int64),
+                                         family="pairs_red")
             t = np.asarray(self._point_feas_red(tj, dj))[:Kc]
             need[lo:lo + Kc] = ~(np.isfinite(t) & (t > 1e-3))
         return need
@@ -401,6 +427,8 @@ class PrunedOracle(Oracle):
                                              feasible_somewhere)
         self.n_solves += idx.size
         self.n_simplex_solves += idx.size
+        self._iters(idx.size * self.n_f32, idx.size * self.n_iter,
+                    idx.size * self.n_iter)
         nzr = int(self._red_dev.H.shape[1])
         nt = self.can.n_theta
         cap = self.max_simplex_rows_per_call
@@ -410,7 +438,8 @@ class PrunedOracle(Oracle):
         zj = np.empty((idx.size, nzr + nt + 1))
         for lo in range(0, idx.size, cap):
             sub = idx[lo:lo + cap]
-            Mj, dj = self._pad_simplex(Ms[sub], ds[sub])
+            Mj, dj = self._pad_simplex(Ms[sub], ds[sub],
+                                       family="simplex_min_red")
             Vc, cc, _f, tc, zc = self._simplex_min_red(Mj, dj)
             n = sub.size
             V[lo:lo + n] = np.asarray(Vc)[:n]
@@ -439,10 +468,16 @@ class PrunedOracle(Oracle):
         super().warm_simplex_bucket(Ms, ds)
         if hasattr(self, "_red_dev"):
             Mj, dj = self._pad_simplex(np.asarray(Ms),
-                                       np.asarray(ds, dtype=np.int64))
+                                       np.asarray(ds, dtype=np.int64),
+                                       family="simplex_min_red")
             self._simplex_min_red(Mj, dj)
 
-    def dispatch_pairs(self, thetas: np.ndarray, delta_idx: np.ndarray):
+    def dispatch_pairs(self, thetas: np.ndarray, delta_idx: np.ndarray,
+                       warm=None):
+        # warm is accepted for signature parity and ignored: the pruned
+        # reduced problem lives in a different variable space, and the
+        # oracle advertises warm_start=False so the frontier never
+        # offers donor data.
         if not hasattr(self, "_red_dev"):
             return super().dispatch_pairs(thetas, delta_idx)
         thetas = np.atleast_2d(np.asarray(thetas, dtype=np.float64))
@@ -454,7 +489,8 @@ class PrunedOracle(Oracle):
         chunks = []
         for lo in range(0, K, cap):
             tj, dj, Kc = self._pad_pairs(thetas[lo:lo + cap],
-                                         delta_idx[lo:lo + cap])
+                                         delta_idx[lo:lo + cap],
+                                         family="pairs_red")
             chunks.append((self._solve_pairs_red(tj, dj), Kc))
         return ("pruned-chunks", thetas, delta_idx, chunks)
 
@@ -504,9 +540,11 @@ class PrunedOracle(Oracle):
         self.n_prune_fallbacks += n_fb
         self.n_solves += thetas.shape[0] + n_fb + n_gate
         self.n_point_solves += thetas.shape[0] + n_fb
-        self._obs_batch("point", thetas.shape[0] + n_fb,
-                        time.perf_counter() - t0,
-                        ipm.schedule_iters(self.point_n_f32,
-                                           self.point_n_iter))
+        n = thetas.shape[0] + n_fb
+        f32 = n * self.point_n_f32 + n_gate * self.n_f32
+        f64 = n * self.point_n_iter + n_gate * self.n_iter
+        self._iters(f32, f64, f64)
+        self._obs_batch("point", n, time.perf_counter() - t0,
+                        f32 + f64, f64)
         self._obs_prune(n_fb, n_gate)
         return np.where(conv, V, _INF), conv, grad, u0, z

@@ -63,6 +63,13 @@ class SOCOracle(Oracle):
             # converged -- silently unsound certificates.
             raise ValueError("SOCOracle does not support rescue_iter or "
                              "point_schedule (linear-kernel programs)")
+        if kw.get("two_phase") or kw.get("warm_start"):
+            # The SOC point closures speak the legacy 8-output wire
+            # format (no duals/slacks ride-along), so the base class's
+            # cohort/warm machinery cannot consume them; the LP joint
+            # programs this oracle inherits stay single-phase with it.
+            raise ValueError("SOCOracle does not support two_phase or "
+                             "warm_start (8-output SOC point programs)")
         kw.setdefault("precision", "f64")  # SOC kernel is f64-only
         super().__init__(problem, **kw)
         self._soc_n_iter = soc_n_iter
@@ -115,8 +122,10 @@ class SOCOracle(Oracle):
         # forwarded like the base Oracle.cpu_twin (ADVICE r5): n_iter /
         # precision drive the LP joint-bound programs, and a twin with
         # different settings would break the bit-compatibility contract.
-        # (rescue_iter / point_schedule are rejected by __init__ and
-        # therefore always at their defaults here.)
+        # (rescue_iter / point_schedule / two_phase / warm_start are
+        # rejected by __init__ and therefore always at their defaults
+        # here -- the twin inherits the same single-phase, cold-start
+        # semantics, keeping fallback results bit-compatible.)
         return SOCOracle(problem, soc_n_iter=self._soc_n_iter,
                          backend="cpu",
                          n_iter=self.n_iter + self.n_f32,

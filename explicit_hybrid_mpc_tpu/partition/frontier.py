@@ -44,8 +44,12 @@ from explicit_hybrid_mpc_tpu.utils.logging import RunLog
 class VertexCache:
     """vertex -> oracle solution row, keyed by rounded coordinates.
 
+    Row layout: (V, conv, grad, u0, z, Vstar, dstar, solved-delta mask,
+    lam, s); lam/s are the per-commutation duals/slacks warm-capable
+    oracles return (the tree warm-start donor data) and None otherwise.
+
     Memory accounting: one row holds the full (nd, ...) per-commutation
-    block (V, conv, grad, u0, z) -- dominated by z at nd x nz float64 --
+    block -- dominated by z at nd x nz and lam/s at nd x nc float64 --
     so an unbounded cache at 10^5 vertices is GBs.  The engine therefore
     EVICTS rows once no open simplex references the vertex (see
     FrontierEngine._release); `peak_vertices`/`peak_bytes` record the
@@ -207,13 +211,12 @@ class FrontierEngine:
             self.log.emit(device_failure=repr(e)[:500], query=method,
                           retry_backend="cpu")
             fb = self._fallback_oracle()
-            before = (fb.n_solves, fb.n_point_solves, fb.n_simplex_solves,
-                      fb.n_rescue_solves)
+            before = fb.stat_snapshot()
             out = getattr(fb, method)(*args)
-            self.oracle.n_solves += fb.n_solves - before[0]
-            self.oracle.n_point_solves += fb.n_point_solves - before[1]
-            self.oracle.n_simplex_solves += fb.n_simplex_solves - before[2]
-            self.oracle.n_rescue_solves += fb.n_rescue_solves - before[3]
+            # Fold EVERY additive stat (solve counts, iteration ledger,
+            # cohort/warm-start counters) so the exact-accounting
+            # figures survive partial device fallback.
+            self.oracle.fold_stats(fb, before)
             return out
         finally:
             self._oracle_s += time.perf_counter() - t0
@@ -263,18 +266,32 @@ class FrontierEngine:
         skipped solve would have returned for an infeasible QP, so the
         build is tree-identical to the unmasked one.
 
+        Tree warm-starts (cfg.warm_start_tree, warm-capable oracles):
+        a missing vertex of a simplex is (almost always) the bisection
+        midpoint of an edge whose endpoints the cache still holds --
+        their converged (z, lam, s) rows are natural IPM starts for the
+        midpoint's QPs.  The plan picks the first cached vertex of the
+        requesting node as DONOR and routes the solve through the warm-
+        capable pair path (per-delta donor slices, `has` set only where
+        the donor cell converged).  Correctness never depends on the
+        donor: the kernel's merit gate falls back to the cold start.
+
         Returns a plan dict for _dispatch_plan/_consume_plan, or None if
         the cache already holds everything.  Planning only reads state
         that is stable between frontier steps (cache rows, inherited
         exclusions of OPEN nodes), which is what makes prefetch planning
         at the end of step k valid for step k+1."""
         nd = self.oracle.can.n_delta
+        can = self.oracle.can
         full = self._full_mask
         use_mask = (nd > 1 and self.oracle.mesh is None
                     and getattr(self.cfg, "mask_point_solves", True)
                     and getattr(self.cfg, "inherit_bounds", True))
+        use_warm = (getattr(self.oracle, "warm_start", False)
+                    and getattr(self.cfg, "warm_start_tree", True))
         need: dict[bytes, np.ndarray] = {}
         vert: dict[bytes, np.ndarray] = {}
+        donor: dict[bytes, tuple] = {}
         for n in nodes:
             act = full
             if use_mask and n in self._inherit:
@@ -283,24 +300,50 @@ class FrontierEngine:
                 if excl:
                     act = full.copy()
                     act[excl] = False
-            for k, v in zip(self._keys(n), self.tree.vertices[n]):
+            keys = self._keys(n)
+            for k, v in zip(keys, self.tree.vertices[n]):
                 cur = need.get(k)
                 if cur is None:
                     need[k] = act
                     vert[k] = v
                 elif cur is not full and act is not cur:
                     need[k] = full if act is full else (cur | act)
+            if use_warm:
+                # First cached vertex of this node that carries duals:
+                # deterministic (node order x key order), so builds stay
+                # reproducible run-to-run.
+                drow = None
+                for k2 in keys:
+                    r = self.cache.get_key(k2)
+                    if r is not None and len(r) > 8 and r[8] is not None:
+                        drow = r
+                        break
+                if drow is not None:
+                    for k2 in keys:
+                        if k2 not in donor:
+                            donor[k2] = drow
         grid_pts: list[np.ndarray] = []
         grid_keys: list[bytes] = []
         pair_verts: list[np.ndarray] = []
         pair_ds: list[np.ndarray] = []
+        warm_z: list[np.ndarray] = []
+        warm_s: list[np.ndarray] = []
+        warm_l: list[np.ndarray] = []
+        warm_h: list[np.ndarray] = []
         # (key, delta indices, offset into the pair batch)
         pair_slices: list[tuple[bytes, np.ndarray, int]] = []
         n_pair = n_skips = n_new = 0
         for k, m in need.items():
             row = self.cache.get_key(k)
+            drow = donor.get(k) if use_warm else None
             if row is None:
                 if m.all():
+                    # Full-need vertices stay on the dense grid program
+                    # (donor or not): rerouting them through the pair
+                    # path measurably slowed the build (per-cell H[d]
+                    # gathers vs the grid's shared-delta vmap), while
+                    # warm starts matter most in the masked deep tail
+                    # whose cells already travel the pair path below.
                     grid_pts.append(vert[k])
                     grid_keys.append(k)
                     continue
@@ -320,6 +363,32 @@ class FrontierEngine:
             pair_slices.append((k, ds, n_pair))
             pair_verts.append(vert[k])
             pair_ds.append(ds)
+            if use_warm:
+                if drow is not None:
+                    warm_z.append(drow[4][ds])
+                    # Centrality floor (Mehrotra-style shifted warm
+                    # start): a converged donor sits ON the boundary
+                    # (active s_i, inactive lam_i ~ 1e-9), and an IPM
+                    # started there crawls -- the merit gate cannot see
+                    # centrality, only residuals.  Flooring slacks/duals
+                    # at 1e-2 re-centers the start while keeping the
+                    # donor's primal point; measured: restores warm
+                    # convergence rates to >= cold everywhere (two-phase
+                    # continuations are NOT floored -- they must resume
+                    # the exact iterate).
+                    warm_l.append(np.maximum(drow[8][ds], 1e-2))
+                    warm_s.append(np.maximum(drow[9][ds], 1e-2))
+                    # Offer only converged donor cells with live duals
+                    # (rescued cells carry NaN donor slots -- the rescue
+                    # program returns no duals; diverged iterates are
+                    # junk the gate would reject anyway).
+                    warm_h.append(np.asarray(drow[1][ds], dtype=bool)
+                                  & np.isfinite(drow[8][ds, 0]))
+                else:
+                    warm_z.append(np.zeros((ds.size, can.nz)))
+                    warm_l.append(np.zeros((ds.size, can.nc)))
+                    warm_s.append(np.zeros((ds.size, can.nc)))
+                    warm_h.append(np.zeros(ds.size, dtype=bool))
             n_pair += ds.size
         if not grid_pts and not pair_slices:
             return None
@@ -329,14 +398,21 @@ class FrontierEngine:
         # fallback args -- was the largest host cost of pure-splitting
         # phases (~6k np.asarray calls per step via np.stack).
         grid_arr = np.stack(grid_pts) if grid_pts else None
+        pair_warm = None
         if pair_slices:
             counts = np.asarray([d.size for d in pair_ds])
             pair_t = np.repeat(np.stack(pair_verts), counts, axis=0)
             pair_d = np.concatenate(pair_ds).astype(np.int64)
+            if use_warm:
+                pair_warm = (np.concatenate(warm_z),
+                             np.concatenate(warm_s),
+                             np.concatenate(warm_l),
+                             np.concatenate(warm_h))
         else:
             pair_t = pair_d = None
         return {"grid_arr": grid_arr, "grid_keys": grid_keys,
                 "pair_t": pair_t, "pair_d": pair_d,
+                "pair_warm": pair_warm,
                 "pair_slices": pair_slices,
                 "n_skips": n_skips, "n_new": n_new + len(grid_pts)}
 
@@ -353,8 +429,16 @@ class FrontierEngine:
                 if plan["grid_arr"] is not None:
                     gh = self.oracle.dispatch_vertices(plan["grid_arr"])
                 if plan["pair_slices"]:
-                    ph = self.oracle.dispatch_pairs(plan["pair_t"],
-                                                    plan["pair_d"])
+                    # The warm kwarg is passed only when donor data was
+                    # planned: legacy oracles (and test doubles) keep
+                    # the two-argument signature.
+                    if plan.get("pair_warm") is not None:
+                        ph = self.oracle.dispatch_pairs(
+                            plan["pair_t"], plan["pair_d"],
+                            warm=plan["pair_warm"])
+                    else:
+                        ph = self.oracle.dispatch_pairs(plan["pair_t"],
+                                                        plan["pair_d"])
         except (RuntimeError, OSError) as e:
             # Mark BOTH parts failed: a raising tunnel rarely delivers
             # the part that did not raise, and the fallback recomputes
@@ -377,22 +461,36 @@ class FrontierEngine:
         self.n_point_skips += plan["n_skips"]
         t0 = time.perf_counter()
         try:
+            full_out = getattr(self.oracle, "_point_full_out", False)
+            nc = self.oracle.can.nc
             if plan["grid_arr"] is not None:
                 # Span = the device-blocking wait: wall >> cpu here is
                 # the per-step device_frac signal at span granularity.
                 with self.obs.span("build.wait_vertices"):
                     sol: VertexSolution = self._wait_or_fallback(
                         "vertices", gh, (plan["grid_arr"],))
+                have_duals = sol.lam is not None
                 for i, k in enumerate(plan["grid_keys"]):
                     self.cache.put_key(
                         k, (sol.V[i], sol.conv[i], sol.grad[i], sol.u0[i],
-                            sol.z[i], sol.Vstar[i], sol.dstar[i], full))
+                            sol.z[i], sol.Vstar[i], sol.dstar[i], full,
+                            sol.lam[i] if have_duals else None,
+                            sol.s[i] if have_duals else None))
             if plan["pair_slices"]:
                 with self.obs.span("build.wait_pairs"):
-                    V, conv, grad, u0, z = self._wait_or_fallback(
-                        "pairs", ph, (plan["pair_t"], plan["pair_d"]))
+                    if full_out:
+                        V, conv, grad, u0, z, lam_p, s_p = \
+                            self._wait_or_fallback(
+                                "pairs_full", ph,
+                                (plan["pair_t"], plan["pair_d"],
+                                 plan.get("pair_warm")))
+                    else:
+                        V, conv, grad, u0, z = self._wait_or_fallback(
+                            "pairs", ph, (plan["pair_t"], plan["pair_d"]))
+                        lam_p = s_p = None
                 nt, nu, nz = (self.problem.n_theta, self.problem.n_u,
                               self.oracle.can.nz)
+                have_duals = lam_p is not None
                 for k, ds, lo in plan["pair_slices"]:
                     row = self.cache.get_key(k)
                     if row is None:
@@ -402,14 +500,25 @@ class FrontierEngine:
                         u0r = np.zeros((nd, nu))
                         zr = np.zeros((nd, nz))
                         maskr = np.zeros(nd, dtype=bool)
+                        lamr = np.zeros((nd, nc)) if have_duals else None
+                        sr = np.zeros((nd, nc)) if have_duals else None
                     else:
                         Vr, convr, gradr = (row[0].copy(), row[1].copy(),
                                             row[2].copy())
                         u0r, zr = row[3].copy(), row[4].copy()
                         maskr = row[7].copy()
+                        lamr = sr = None
+                        if have_duals:
+                            lamr = (row[8].copy() if row[8] is not None
+                                    else np.zeros((nd, nc)))
+                            sr = (row[9].copy() if row[9] is not None
+                                  else np.zeros((nd, nc)))
                     sl = slice(lo, lo + ds.size)
                     Vr[ds], convr[ds], gradr[ds] = V[sl], conv[sl], grad[sl]
                     u0r[ds], zr[ds] = u0[sl], z[sl]
+                    if have_duals:
+                        lamr[ds] = lam_p[sl]
+                        sr[ds] = s_p[sl]
                     maskr[ds] = True
                     # Same reduction as oracle.reduce_deltas (first
                     # minimum): skipped cells are +inf/unconverged, so the
@@ -420,7 +529,8 @@ class FrontierEngine:
                     self.cache.put_key(k, (Vr, convr, gradr, u0r, zr, Vs,
                                            np.int64(j if np.isfinite(Vs)
                                                     else -1),
-                                           full if maskr.all() else maskr))
+                                           full if maskr.all() else maskr,
+                                           lamr, sr))
         finally:
             self._oracle_s += time.perf_counter() - t0
 
@@ -431,21 +541,30 @@ class FrontierEngine:
             if isinstance(handle, tuple) and len(handle) == 2 \
                     and handle[0] == "failed":
                 raise handle[1]
-            return (self.oracle.wait_vertices(handle) if kind == "vertices"
-                    else self.oracle.wait_pairs(handle))
+            if kind == "vertices":
+                return self.oracle.wait_vertices(handle)
+            if kind == "pairs_full":
+                return self.oracle.wait_pairs_full(handle)
+            return self.oracle.wait_pairs(handle)
         except (RuntimeError, OSError) as e:
             self.n_device_failures += 1
             self.log.emit(device_failure=repr(e)[:500],
                           query=f"dispatch_{kind}", retry_backend="cpu")
             fb = self._fallback_oracle()
-            before = (fb.n_solves, fb.n_point_solves, fb.n_simplex_solves,
-                      fb.n_rescue_solves)
-            out = (fb.solve_vertices(*args) if kind == "vertices"
-                   else fb.solve_pairs(*args))
-            self.oracle.n_solves += fb.n_solves - before[0]
-            self.oracle.n_point_solves += fb.n_point_solves - before[1]
-            self.oracle.n_simplex_solves += fb.n_simplex_solves - before[2]
-            self.oracle.n_rescue_solves += fb.n_rescue_solves - before[3]
+            before = fb.stat_snapshot()
+            if kind == "vertices":
+                out = fb.solve_vertices(*args)
+            elif kind == "pairs_full":
+                # The twin mirrors two_phase/warm_start (cpu_twin), so
+                # the re-solve consumes the same warm donors and returns
+                # the same extended tuple.
+                out = fb.solve_pairs_full(args[0], args[1], warm=args[2])
+            else:
+                out = fb.solve_pairs(*args)
+            # Fold every additive stat (see Oracle._FOLD_STATS), not
+            # just solve counts: the iteration ledger backs the
+            # documented-exact ipm_iters/wasted_iter_frac figures.
+            self.oracle.fold_stats(fb, before)
             return out
 
     def _gather_batch(self, nodes: list[int]) -> tuple[dict, tuple]:
@@ -948,7 +1067,20 @@ class FrontierEngine:
         eng = cls.__new__(cls)
         eng.problem = problem
         eng.oracle = oracle
-        eng.cfg = cfg if cfg is not None else snap["cfg"]
+        if cfg is None:
+            cfg_snap = snap["cfg"]
+            # Conservative back-fill for pre-knob snapshots: the
+            # two-phase/warm-start class defaults are True, but a
+            # resumed old build must keep its original single-phase
+            # cold-start semantics mid-build (resumed-equals-straight
+            # parity; main.py applies the same back-fill on its path).
+            for fld, legacy in (("ipm_two_phase", False),
+                                ("ipm_phase1_iters", None),
+                                ("warm_start_tree", False)):
+                if fld not in cfg_snap.__dict__:
+                    object.__setattr__(cfg_snap, fld, legacy)
+            cfg = cfg_snap
+        eng.cfg = cfg
         eng.log = log or RunLog(eng.cfg.log_path, echo=False)
         eng.tree = snap["tree"]
         eng.roots = snap["roots"]
@@ -968,9 +1100,15 @@ class FrontierEngine:
         eng._full_mask = np.ones(oracle.can.n_delta, dtype=bool)
         # Cache rows from pre-masking checkpoints lack the solved-delta
         # mask (8th element): every cell in them was actually solved.
+        # Rows from pre-warm-start checkpoints lack the duals/slacks
+        # (9th/10th): None = no donor data, midpoints of those vertices
+        # simply start cold (the cache is a cache -- correctness is
+        # unaffected, only the warm-start hit rate).
         for k, row in eng.cache._d.items():
             if len(row) == 7:
-                eng.cache._d[k] = (*row, eng._full_mask)
+                eng.cache._d[k] = (*row, eng._full_mask, None, None)
+            elif len(row) == 8:
+                eng.cache._d[k] = (*row, None, None)
         eng._fb_oracle = None
         eng._oracle_s = 0.0
         oracle.n_solves = snap.get("n_solves", 0)
@@ -1013,7 +1151,16 @@ def make_oracle(problem, cfg: PartitionConfig, mesh=None,
     the plain oracle (the library default)."""
     kw = dict(backend=cfg.backend, mesh=mesh, precision=cfg.precision,
               point_schedule=getattr(cfg, "ipm_point_schedule", None),
-              rescue_iter=getattr(cfg, "ipm_rescue_iters", 0))
+              rescue_iter=getattr(cfg, "ipm_rescue_iters", 0),
+              # The getattr FALLBACKS (reached only for pre-knob
+              # pickled checkpoint cfgs) are conservative False: a
+              # resumed old build must keep its original single-phase
+              # cold-start semantics mid-build (resumed-equals-straight
+              # parity), not silently adopt the new defaults.  Fresh
+              # configs carry the dataclass defaults (True).
+              two_phase=getattr(cfg, "ipm_two_phase", False),
+              phase1_iters=getattr(cfg, "ipm_phase1_iters", None),
+              warm_start=getattr(cfg, "warm_start_tree", False))
     if getattr(cfg, "prune_rows", False):
         if cfg.backend == "serial" or mesh is not None:
             if strict:

@@ -8,12 +8,16 @@ prints:
 
 - build throughput: steps, regions, regions/sec, device_frac trend;
 - oracle solve-time p50/p99 per QP class (point/simplex/rescue) plus
-  IPM iteration volume, from the last metrics snapshot's histograms;
+  IPM iteration volume, from the last metrics snapshot's histograms,
+  and the adaptive-work rates (wasted_iter_frac, phase2_survivor_frac,
+  warmstart_accept_rate, compiled-shape count) from its gauges;
 - serving: per-shard query-latency p50/p99, batch sizes, routing mode
   counts, shard imbalance;
 - a diff against a BENCH_*.json (default: the newest in the repo root)
   flagging >tol regressions in regions/sec and histogram p99s against
-  the bench's own `metrics` block.
+  the bench's own `metrics` block, plus iteration-economy regressions
+  (lower wasted_iter_frac / warmstart_accept_rate than the bench
+  recorded) so extra arithmetic per region is flagged like latency.
 
 Usage:
     python scripts/obs_report.py RUN.obs.jsonl [--bench BENCH.json]
@@ -92,10 +96,18 @@ def report(records: list[dict]) -> dict:
         out["histograms"] = {k: histogram_row(h) for k, h in hists.items()}
         oracle = {k.split(".", 1)[1]: v for k, v in out["histograms"].items()
                   if k.startswith("oracle.")}
-        if oracle:
+        if oracle or any(k.startswith("oracle.") for k in out["gauges"]):
             out["oracle"] = oracle
             out["oracle"]["ipm_iters"] = out["counters"].get(
                 "oracle.ipm_iters")
+            out["oracle"]["ipm_iters_f64"] = out["counters"].get(
+                "oracle.ipm_iters_f64")
+            # Adaptive-work rates (two-phase cohort + tree warm-starts):
+            # cumulative gauges the oracle refreshes every batch.
+            for g in ("wasted_iter_frac", "phase2_survivor_frac",
+                      "warmstart_accept_rate", "compiled_shapes"):
+                if f"oracle.{g}" in out["gauges"]:
+                    out["oracle"][g] = out["gauges"][f"oracle.{g}"]
         shards = {}
         for k, v in out["histograms"].items():
             if k.startswith(_SHARD_PREFIX) and k.endswith(".query_s"):
@@ -141,6 +153,20 @@ def diff_bench(rep: dict, bench: dict, tol: float = 0.10) -> list[str]:
             flags.append(
                 f"{name} p99 regression: {p99:.3g}s vs bench "
                 f"{bp99:.3g}s ({100 * (p99 / bp99 - 1):.0f}% slower)")
+    # Iteration-economy regressions are flagged like latency ones
+    # (ISSUE 3): a run that saves a smaller fraction of the fixed f64
+    # schedule, or whose tree warm-starts stop being accepted, is doing
+    # more arithmetic per region even if wall-clock noise hides it.
+    orc = rep.get("oracle", {})
+    for field, label in (("wasted_iter_frac", "f64-iteration savings"),
+                         ("warmstart_accept_rate",
+                          "warm-start accept rate")):
+        bval_f = bench.get(field)
+        rval = orc.get(field)
+        if bval_f and rval is not None and rval < (1 - tol) * bval_f:
+            flags.append(
+                f"{label} regression: {rval:.3f} vs bench {bval_f:.3f} "
+                f"({100 * (1 - rval / bval_f):.0f}% lower)")
     # Serving headline: sharded us/query against the bench's large-L
     # figure, when both sides measured it.
     b_us = bench.get("large_l_sharded_us_per_query")
@@ -181,7 +207,18 @@ def render_text(rep: dict, flags: list[str], bench_path: str | None) -> str:
                           f"{_fmt_lat(row['p50'])}, p99 "
                           f"{_fmt_lat(row['p99'])}")
         if orc.get("ipm_iters"):
-            ln.append(f"oracle IPM iterations: {orc['ipm_iters']}")
+            it_line = f"oracle IPM iterations: {orc['ipm_iters']}"
+            if orc.get("ipm_iters_f64"):
+                it_line += f" ({orc['ipm_iters_f64']} f64)"
+            ln.append(it_line)
+        if orc.get("wasted_iter_frac") is not None:
+            ln.append(
+                f"adaptive work: wasted_iter_frac "
+                f"{orc['wasted_iter_frac']:.3f}, phase2 survivors "
+                f"{orc.get('phase2_survivor_frac', 0.0):.3f}, "
+                f"warm-start accept "
+                f"{orc.get('warmstart_accept_rate', 0.0):.3f}, "
+                f"{int(orc.get('compiled_shapes', 0))} compiled shapes")
     srv = rep.get("serve")
     if srv:
         ln.append(f"serve: {srv.get('queries')} queries "

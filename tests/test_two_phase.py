@@ -1,0 +1,293 @@
+"""Two-phase early-exit cohort + tree warm-start tests (ISSUE 3).
+
+Covers: the kernel's merit-gated warm_start path (rejection is bitwise
+cold; continuation reaches full-schedule quality), exact iteration
+accounting under the cohort (phase1 x cells + phase2 x survivors),
+mixed-precision composition (f32_ok semantics unchanged), build-level
+tree identity of the two-phase path, warm-start acceptance in a real
+build, and the "warm shapes == run shapes" compiled-shape guard.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+from explicit_hybrid_mpc_tpu import obs as obs_lib
+from explicit_hybrid_mpc_tpu.config import PartitionConfig
+from explicit_hybrid_mpc_tpu.oracle import ipm
+from explicit_hybrid_mpc_tpu.oracle.oracle import Oracle
+from explicit_hybrid_mpc_tpu.partition.frontier import (build_partition,
+                                                        make_oracle)
+from explicit_hybrid_mpc_tpu.problems.registry import make
+
+
+def _rand_qp(seed, nz=8, nc=20):
+    rng = np.random.default_rng(seed)
+    W = rng.normal(size=(nz, nz))
+    Q = W @ W.T + np.eye(nz)
+    q = rng.normal(size=nz)
+    A = rng.normal(size=(nc, nz))
+    b = np.abs(rng.normal(size=nc)) + 0.5  # z=0 strictly feasible
+    return tuple(jnp.asarray(x) for x in (Q, q, A, b))
+
+
+# -- kernel-level warm-start semantics ---------------------------------------
+
+
+def test_invalid_warm_start_is_bitwise_cold():
+    """valid=False must be indistinguishable from no warm start at all
+    (the cold trajectory is selected cell-exactly)."""
+    Q, q, A, b = _rand_qp(0)
+    cold = ipm.qp_solve(Q, q, A, b)
+    warm = (jnp.ones(Q.shape[0]), jnp.ones(A.shape[0]),
+            jnp.ones(A.shape[0]), jnp.asarray(False))
+    gated = ipm.qp_solve(Q, q, A, b, warm_start=warm)
+    assert not bool(gated.warm_ok)
+    np.testing.assert_array_equal(np.asarray(cold.z), np.asarray(gated.z))
+    np.testing.assert_array_equal(np.asarray(cold.lam),
+                                  np.asarray(gated.lam))
+    assert bool(cold.converged) == bool(gated.converged)
+
+
+def test_bad_warm_start_rejected_by_merit_gate():
+    """A garbage warm start (huge primal, boundary slacks) has worse
+    merit than the cold start: the gate must reject it and the result
+    must equal the cold solve of the same length, bitwise."""
+    Q, q, A, b = _rand_qp(1)
+    bad = (1e6 * jnp.ones(Q.shape[0]), 1e-9 * jnp.ones(A.shape[0]),
+           1e6 * jnp.ones(A.shape[0]), jnp.asarray(True))
+    got = ipm.qp_solve(Q, q, A, b, n_iter=8, warm_start=bad)
+    ref = ipm.qp_solve(Q, q, A, b, n_iter=8)
+    assert not bool(got.warm_ok)
+    np.testing.assert_array_equal(np.asarray(got.z), np.asarray(ref.z))
+
+
+def test_two_phase_continuation_reaches_full_schedule():
+    """phase1(18) + merit-gated warm phase2(12) must reach what a cold
+    30-iteration solve reaches (the cohort's correctness argument)."""
+    Q, q, A, b = _rand_qp(2, nz=10, nc=30)
+    full = ipm.qp_solve(Q, q, A, b, n_iter=30)
+    p1 = ipm.qp_solve(Q, q, A, b, n_iter=18)
+    p2 = ipm.qp_solve(Q, q, A, b, n_iter=12,
+                      warm_start=(p1.z, p1.s, p1.lam, jnp.asarray(True)))
+    assert bool(full.converged) and bool(p2.converged)
+    assert bool(p2.warm_ok)
+    f = float(full.obj)
+    assert abs(float(p2.obj) - f) < 1e-7 * (1 + abs(f))
+
+
+def test_f32_semantics_unchanged_under_warm_composition():
+    """Satellite: f32_ok keeps its meaning when mixed precision composes
+    with the warm path -- an invalid warm start plus the mixed schedule
+    is bitwise the plain mixed schedule."""
+    Q, q, A, b = _rand_qp(3, nz=12, nc=40)
+    mix = ipm.qp_solve(Q, q, A, b, n_iter=10, n_f32=20)
+    warm0 = (jnp.zeros(Q.shape[0]), jnp.zeros(A.shape[0]),
+             jnp.zeros(A.shape[0]), jnp.asarray(False))
+    mix2 = ipm.qp_solve(Q, q, A, b, n_iter=10, n_f32=20, warm_start=warm0)
+    assert bool(mix.converged)
+    assert bool(mix2.f32_ok) == bool(mix.f32_ok)
+    assert not bool(mix2.warm_ok)
+    np.testing.assert_array_equal(np.asarray(mix.z), np.asarray(mix2.z))
+
+
+# -- oracle-level cohort + accounting ----------------------------------------
+
+
+def test_two_phase_oracle_matches_single_phase_grid():
+    prob = make("inverted_pendulum", N=2)
+    rng = np.random.default_rng(4)
+    th = rng.uniform(prob.theta_lb, prob.theta_ub, size=(12, 2))
+    base = Oracle(prob, backend="cpu")
+    tp = Oracle(prob, backend="cpu", two_phase=True)
+    sb, st = base.solve_vertices(th), tp.solve_vertices(th)
+    np.testing.assert_array_equal(sb.conv, st.conv)
+    np.testing.assert_array_equal(sb.dstar, st.dstar)
+    c = sb.conv
+    np.testing.assert_allclose(st.V[c], sb.V[c], atol=1e-7)
+    # The cohort actually engaged and saved f64 work.
+    nd = prob.canonical.n_delta
+    assert tp.n_tp_cells == 12 * nd
+    # Diverged-cell early exit keeps the survivor set well below the
+    # cell count (most unconverged cells are diverging-infeasible).
+    assert tp.n_tp_survivors < tp.n_tp_cells
+    assert tp.n_iters_f64 < tp.n_iters_f64_fixed
+    assert base.n_iters_f64 == base.n_iters_f64_fixed
+    # Full-output path returns the warm-start donor data.
+    assert st.lam is not None and st.lam.shape == (12, nd,
+                                                   prob.canonical.nc)
+
+
+def test_exact_iteration_accounting_mixed_two_phase():
+    """Satellite: oracle.ipm_iters == phase1 schedule x cells + phase2
+    length x survivors, exactly, with mixed precision composed in."""
+    prob = make("inverted_pendulum", N=2)
+    o = obs_lib.Obs("jsonl")
+    orc = Oracle(prob, backend="cpu", precision="mixed", n_f32=20,
+                 two_phase=True, warm_start=True, obs=o)
+    rng = np.random.default_rng(5)
+    th = rng.uniform(prob.theta_lb, prob.theta_ub, size=(9, 2))
+    orc.solve_vertices(th)
+    nd = prob.canonical.n_delta
+    N = 9 * nd
+    assert orc.n_tp_cells == N
+    assert orc.n_iters_f32 == N * orc.point_n_f32
+    assert orc.n_iters_f64 == (N * orc.point_p1
+                               + orc.n_tp_survivors * orc.point_p2)
+    assert orc.n_iters_f64_fixed == N * orc.point_n_iter
+    got = o.metrics.counter("oracle.ipm_iters").value
+    assert got == orc.n_iters_f32 + orc.n_iters_f64
+    assert (o.metrics.counter("oracle.ipm_iters_f64").value
+            == orc.n_iters_f64)
+    # The rate gauges mirror the ledger.
+    g = o.metrics.gauge("oracle.wasted_iter_frac").value
+    assert abs(g - orc.wasted_iter_frac) < 1e-12
+    assert (o.metrics.gauge("oracle.phase2_survivor_frac").value
+            == orc.phase2_survivor_frac)
+
+
+def test_phase1_iters_override_and_validation():
+    prob = make("double_integrator", N=3, theta_box=1.5)
+    orc = Oracle(prob, backend="cpu", two_phase=True, phase1_iters=25)
+    assert orc.point_p1 == 25 and orc.point_p2 == 5
+    try:
+        Oracle(prob, backend="cpu", two_phase=True, phase1_iters=0)
+        raise AssertionError("phase1_iters=0 must be rejected")
+    except ValueError:
+        pass
+    # Degenerate split (phase1 >= schedule) falls back to single phase.
+    deg = Oracle(prob, backend="cpu", two_phase=True, phase1_iters=99)
+    assert not deg._point_cohort and not deg._simplex_cohort
+    # serial forces the knobs off (the conservative baseline contract).
+    ser = Oracle(prob, backend="serial", two_phase=True, warm_start=True)
+    assert not ser.two_phase and not ser.warm_start
+
+
+def test_cpu_twin_mirrors_two_phase_knobs():
+    prob = make("inverted_pendulum", N=2)
+    orc = Oracle(prob, backend="cpu", two_phase=True, phase1_iters=17,
+                 warm_start=True)
+    twin = orc.cpu_twin(prob)
+    assert twin.two_phase and twin.warm_start
+    assert twin.phase1_iters == 17
+    assert (twin.point_p1, twin.point_p2) == (orc.point_p1, orc.point_p2)
+
+
+# -- build-level parity + warm starts ----------------------------------------
+
+
+def test_two_phase_build_tree_identical():
+    """The two-phase cohort is a pure work optimization: survivors get
+    exactly the remaining schedule, so the partition (regions, nodes,
+    leaf deltas, leaf geometry) must be IDENTICAL to the single-phase
+    build's, at strictly fewer f64 iterations."""
+    prob = make("inverted_pendulum", N=2)
+    out = {}
+    for tp in (False, True):
+        cfg = PartitionConfig(problem="inverted_pendulum", eps_a=0.5,
+                              backend="cpu", batch_simplices=32,
+                              max_depth=10, ipm_two_phase=tp,
+                              warm_start_tree=False)
+        orc = make_oracle(prob, cfg)
+        res = build_partition(prob, cfg, oracle=orc)
+        leaves = res.tree.converged_leaves()
+        out[tp] = ((res.stats["regions"], res.stats["tree_nodes"],
+                    res.stats["uncertified"],
+                    [res.tree.leaf_data[n].delta_idx for n in leaves],
+                    [res.tree.vertices[n].tobytes() for n in leaves]),
+                   orc)
+    assert out[False][0] == out[True][0]
+    orc = out[True][1]
+    assert orc.n_iters_f64 < orc.n_iters_f64_fixed
+    assert orc.phase2_survivor_frac > 0.0
+    assert orc.wasted_iter_frac > 0.0
+
+
+def test_warm_start_build_accepts_donors_and_stays_sound(rng):
+    """Tree warm-starts in a real build: donors flow, the merit gate
+    accepts re-centered sibling iterates, and the resulting partition
+    keeps the eps-suboptimality guarantee at sampled points."""
+    prob = make("inverted_pendulum", N=2)
+    cfg = PartitionConfig(problem="inverted_pendulum", eps_a=0.5,
+                          backend="cpu", batch_simplices=32, max_depth=12,
+                          ipm_two_phase=True, warm_start_tree=True)
+    orc = make_oracle(prob, cfg)
+    assert orc.warm_start
+    res = build_partition(prob, cfg, oracle=orc)
+    assert orc.n_warm_attempts > 0
+    assert orc.warmstart_accept_rate > 0.5
+    tree = res.tree
+    ref = Oracle(prob, backend="cpu")
+    pts = rng.uniform(prob.theta_lb, prob.theta_ub, size=(12, 2))
+    sol = ref.solve_vertices(pts)
+    from explicit_hybrid_mpc_tpu.partition import geometry
+    checked = 0
+    for k, th in enumerate(pts):
+        n = tree.locate(th, res.roots)
+        if n < 0 or tree.leaf_data[n] is None:
+            continue
+        ld = tree.leaf_data[n]
+        if not ld.certified or not np.isfinite(sol.Vstar[k]):
+            continue
+        lam = geometry.barycentric(tree.vertices[n], th)
+        J = lam @ ld.vertex_costs
+        assert J <= sol.Vstar[k] + 0.5 + 1e-6
+        checked += 1
+    assert checked > 0
+
+
+def test_iteration_ledger_folds_through_device_fallback():
+    """A device failure rerouted to the CPU twin must fold the ENTIRE
+    statistic set back -- the iteration ledger behind the exact
+    ipm_iters / wasted_iter_frac figures, not just solve counts."""
+    from explicit_hybrid_mpc_tpu.partition.frontier import FrontierEngine
+
+    prob = make("inverted_pendulum", N=2)
+
+    class Flaky(Oracle):
+        def __init__(self, *a, **k):
+            super().__init__(*a, **k)
+            self._n = 0
+
+        def dispatch_pairs(self, th, ds, warm=None):
+            self._n += 1
+            if self._n % 2 == 1:
+                raise RuntimeError("injected device failure")
+            return super().dispatch_pairs(th, ds, warm=warm)
+
+    cfg = PartitionConfig(problem="inverted_pendulum", eps_a=0.5,
+                          backend="cpu", batch_simplices=32, max_depth=8)
+    flaky = Flaky(prob, backend="cpu", two_phase=True, warm_start=True)
+    eng = FrontierEngine(prob, flaky, cfg)
+    res = eng.run()
+    clean = Oracle(prob, backend="cpu", two_phase=True, warm_start=True)
+    res2 = build_partition(prob, cfg, oracle=clean)
+    assert eng.n_device_failures > 0
+    assert res.stats["regions"] == res2.stats["regions"]
+    assert flaky.n_iters_f64 == clean.n_iters_f64
+    assert flaky.n_iters_f64_fixed == clean.n_iters_f64_fixed
+    assert flaky.n_tp_cells == clean.n_tp_cells
+
+
+def test_compiled_shapes_warm_covers_build():
+    """Shape-guard satellite: a short build must not JIT any padded
+    bucket bench.warm_oracle didn't pre-warm -- now including the
+    phase-2 cohort buckets."""
+    import bench
+
+    prob = make("inverted_pendulum", N=2)
+    cfg = PartitionConfig(problem="inverted_pendulum", eps_a=0.5,
+                          backend="cpu", batch_simplices=16, max_depth=8,
+                          max_steps=6)
+    orc = make_oracle(prob, cfg)
+    assert orc.two_phase and orc.warm_start  # cfg defaults reach oracle
+    # Shrink every bucket family so the sweep stays test-sized.
+    orc.points_cap = 64
+    orc.max_pairs_per_call = 64
+    orc.max_simplex_rows_per_call = 64
+    bench.warm_oracle(orc, prob)
+    warm = set(orc.compiled_shapes)
+    assert any(f == "pairs_p2" for f, _ in warm)  # cohort buckets warmed
+    assert any(f == "simplex_p2" for f, _ in warm)
+    build_partition(prob, cfg, oracle=orc)
+    new = orc.compiled_shapes - warm
+    assert not new, f"unwarmed shapes JITed mid-build: {sorted(new)}"

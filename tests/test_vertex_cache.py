@@ -1,0 +1,85 @@
+"""Direct unit tests for VertexCache eviction + refcount accounting
+(ISSUE 3 satellite): `_release` must drop a vertex only when no open
+simplex references it, and the peak_vertices/peak_bytes high-water
+marks must survive a release-then-reinsert cycle."""
+
+import numpy as np
+
+from explicit_hybrid_mpc_tpu.config import PartitionConfig
+from explicit_hybrid_mpc_tpu.oracle.oracle import Oracle
+from explicit_hybrid_mpc_tpu.partition.frontier import (FrontierEngine,
+                                                        VertexCache)
+from explicit_hybrid_mpc_tpu.problems.registry import make
+
+EPS = 0.5
+
+
+def _row():
+    return (np.zeros(1), np.zeros(1, dtype=bool), np.zeros((1, 2)),
+            np.zeros((1, 1)), np.zeros((1, 3)), 0.0, np.int64(0),
+            np.ones(1, dtype=bool), None, None)
+
+
+def test_release_drops_vertex_only_when_unreferenced():
+    """The box triangulation's root simplices share vertices: releasing
+    ONE root must keep every shared row alive (refcount > 0) and evict
+    only that root's exclusive rows; releasing the other root then
+    drains the cache and the refcount map completely."""
+    prob = make("double_integrator", N=3, theta_box=1.5)
+    cfg = PartitionConfig(problem="double_integrator", eps_a=EPS,
+                          backend="cpu", batch_simplices=8)
+    eng = FrontierEngine(prob, Oracle(prob, backend="cpu"), cfg)
+    assert len(eng.roots) == 2  # 2-D box -> 2 triangles
+    n0, n1 = eng.roots
+    k0, k1 = set(eng._keys(n0)), set(eng._keys(n1))
+    shared = k0 & k1
+    assert shared and (k0 - shared)  # diagonal shared, corners exclusive
+    for k in k0 | k1:
+        eng.cache.put_key(k, _row())
+    eng._release(n0)
+    for k in shared:
+        assert eng.cache.get_key(k) is not None, "shared row evicted early"
+        assert eng._refcount[k] == 1
+    for k in k0 - shared:
+        assert eng.cache.get_key(k) is None, "exclusive row not evicted"
+        assert k not in eng._refcount
+    # Release-then-reinsert: retaining n0 again must re-count its keys
+    # without disturbing n1's.
+    eng._retain(n0)
+    for k in shared:
+        assert eng._refcount[k] == 2
+    eng._release(n0)
+    eng._release(n1)
+    assert len(eng.cache) == 0
+    assert eng._refcount == {}
+
+
+def test_peak_accounting_survives_release_then_reinsert():
+    c = VertexCache()
+    row = _row()
+    for i in range(3):
+        c.put_key(bytes([i]), row)
+    assert c.peak_vertices == 3
+    row_bytes = c._row_bytes
+    assert row_bytes > 0
+    assert c.peak_bytes == 3 * row_bytes
+    # Evict below the high-water mark; reinsert back up to it.
+    c.evict_key(b"\x00")
+    c.evict_key(b"\x01")
+    assert len(c) == 1
+    c.put_key(b"\x05", row)
+    assert len(c) == 2
+    assert c.peak_vertices == 3, "high-water mark must not regress"
+    assert c.peak_bytes == 3 * row_bytes
+    # A genuinely new high water moves both marks.
+    c.put_key(b"\x06", row)
+    c.put_key(b"\x07", row)
+    assert c.peak_vertices == 4
+    assert c.peak_bytes == 4 * row_bytes
+
+
+def test_evict_missing_key_is_noop():
+    c = VertexCache()
+    c.put_key(b"a", _row())
+    c.evict_key(b"zzz")  # must not raise
+    assert len(c) == 1

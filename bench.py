@@ -886,6 +886,35 @@ def main() -> int:
             os.makedirs(os.path.dirname(out_path) or ".", exist_ok=True)
             with open(out_path, "w") as f:
                 json.dump(result, f, indent=2)
+        # Bench-trajectory rollup: every successful capture appends its
+        # condensed row to BENCH_HISTORY.jsonl so the regression gate
+        # (scripts/bench_gate.py, the documented pre-merge check) has a
+        # trailing window to compare against.  BENCH_HISTORY overrides
+        # the path; the empty string disables (the test suite's smoke
+        # benches must not pollute the committed history).  Best-
+        # effort: history is observability, and the un-killable
+        # contract forbids it to fail the capture.
+        hist_path = os.environ.get("BENCH_HISTORY")
+        if result.get("value") is not None and hist_path != "":
+            try:
+                sys.path.insert(0, os.path.join(
+                    os.path.dirname(os.path.abspath(__file__)),
+                    "scripts"))
+                import bench_gate
+
+                # mtime keys the dedup: use the just-written artifact's
+                # OWN mtime so a later `bench_gate.py --update` pass
+                # over the same file recognizes the row instead of
+                # appending a duplicate (and the gate's self-exclusion
+                # matches).
+                bench_gate.append_history(
+                    result,
+                    source=(out_path or f"bench_{int(T_START)}"),
+                    path=hist_path or bench_gate.HISTORY,
+                    mtime=(round(os.path.getmtime(out_path), 3)
+                           if out_path else round(T_START, 3)))
+            except Exception as e:
+                log(f"bench history append skipped: {e!r}")
     return 0 if result.get("value") is not None else 1
 
 

@@ -159,6 +159,28 @@ class PartitionConfig:
     # legacy flat per-step RunLog stream, kept for existing consumers).
     obs: str = "off"
     obs_path: Optional[str] = None
+    # Flight recorder (obs/recorder.py): when True, solver anomalies --
+    # cells still feasible-but-unconverged after the two-phase cohort
+    # and the rescue pass, simplex rows with no usable bound, device-
+    # failure batches, depth-capped uncertified leaves -- are dumped as
+    # versioned compressed repro bundles under `recorder_dir`;
+    # scripts/replay_solve.py re-runs a bundle standalone and must
+    # reproduce the converged/diverged mask bit-for-bit.  Works with
+    # obs='off' too (the recorder's ring is just empty then); every
+    # hook is a None-check when disabled.
+    obs_recorder: bool = False
+    # Bundle directory (default artifacts/repro).  Setting it IMPLIES
+    # obs_recorder -- naming a bundle directory while recording nothing
+    # would be a silent no-op trap (frontier._init_diagnostics).
+    recorder_dir: Optional[str] = None
+    # Streaming health rules as (name, value) override pairs on
+    # obs.health.DEFAULT_RULES (tuple: frozen-friendly, like
+    # problem_args).  Non-empty AND obs enabled => the frontier engine
+    # feeds an in-stream HealthMonitor per step (plus a periodic
+    # metrics snapshot) and structured health.* events land in the obs
+    # stream.  scripts/obs_watch.py applies the same schema to a live
+    # stream from outside the process.
+    health_rules: tuple = ()
     seed: int = 0
 
     def __post_init__(self) -> None:
@@ -175,3 +197,9 @@ class PartitionConfig:
         if self.ipm_phase1_iters is not None and self.ipm_phase1_iters < 1:
             raise ValueError("ipm_phase1_iters must be >= 1 (or None for "
                              "the automatic 2/5 split)")
+        if self.health_rules:
+            # Validate rule names eagerly: a typo'd rule that silently
+            # never fires defeats the watchdog's purpose.
+            from explicit_hybrid_mpc_tpu.obs.health import rules_from_pairs
+
+            rules_from_pairs(self.health_rules)

@@ -92,9 +92,45 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--obs-path", metavar="FILE", default=None,
                    help="override the obs stream path "
                         "(default PREFIX.obs.jsonl)")
+    p.add_argument("--recorder", action="store_true",
+                   help="flight recorder: dump versioned compressed "
+                        "repro bundles on solver anomalies (diverged "
+                        "cells, simplex stalls, device failures, "
+                        "uncertified leaves); replay them with "
+                        "scripts/replay_solve.py")
+    p.add_argument("--recorder-dir", metavar="DIR", default=None,
+                   help="bundle directory (default PREFIX.repro/)")
+    p.add_argument("--health-rule", action="append", default=[],
+                   metavar="NAME=VALUE",
+                   help="override a streaming health rule (repeatable; "
+                        "see obs.health.DEFAULT_RULES).  Any override "
+                        "activates the in-build watchdog: health.* "
+                        "events land in the obs stream (needs --obs)")
     p.add_argument("--list", action="store_true",
                    help="list registered problems and exit")
     return p
+
+
+def _parse_health_rules(pairs: list[str]) -> tuple:
+    """NAME=VALUE pairs -> cfg.health_rules tuple, with CLI-friendly
+    errors.  Name/value validation is delegated to the ONE validator
+    (obs.health.rules_from_pairs) so the known-rule list can never go
+    stale here."""
+    if not pairs:
+        return ()
+    from explicit_hybrid_mpc_tpu.obs.health import rules_from_pairs
+
+    out = []
+    for kv in pairs:
+        if "=" not in kv:
+            raise SystemExit(f"--health-rule needs NAME=VALUE, got {kv!r}")
+        k, v = kv.split("=", 1)
+        try:
+            rules_from_pairs([(k, float(v))])
+        except ValueError as e:
+            raise SystemExit(f"--health-rule: {e}")
+        out.append((k, float(v)))
+    return tuple(out)
 
 
 def _parse_problem_args(pairs: list[str]) -> dict:
@@ -146,6 +182,12 @@ def main(argv: list[str] | None = None) -> int:
                                                             make_oracle)
     from explicit_hybrid_mpc_tpu.utils.logging import RunLog
 
+    if args.health_rule and args.obs == "off":
+        # The in-build watchdog lives on the obs stream; configuring
+        # rules that can never fire is the exact silent failure the
+        # rule-name validation exists to prevent.
+        raise SystemExit("--health-rule requires --obs jsonl|full "
+                         "(the watchdog evaluates the obs stream)")
     problem_args = _parse_problem_args(args.problem_arg)
     prefix = args.output
     os.makedirs(os.path.dirname(prefix) or ".", exist_ok=True)
@@ -170,7 +212,13 @@ def main(argv: list[str] | None = None) -> int:
         profile_path=args.profile, profile_steps=args.profile_steps,
         obs=args.obs,
         obs_path=(args.obs_path or f"{prefix}.obs.jsonl"
-                  if args.obs != "off" else None))
+                  if args.obs != "off" else None),
+        # --recorder-dir implies --recorder: naming a bundle directory
+        # and silently recording nothing would be the worst reading.
+        obs_recorder=args.recorder or bool(args.recorder_dir),
+        recorder_dir=(args.recorder_dir or f"{prefix}.repro"
+                      if args.recorder or args.recorder_dir else None),
+        health_rules=_parse_health_rules(args.health_rule))
 
     if snapshot is not None:
         # SOLVER flags (precision/backend/eps/batch...) come from the
@@ -229,7 +277,13 @@ def main(argv: list[str] | None = None) -> int:
             checkpoint_path=cfg.checkpoint_path,
             profile_path=cfg.profile_path,
             profile_steps=cfg.profile_steps,
-            obs=cfg.obs, obs_path=cfg.obs_path)
+            obs=cfg.obs, obs_path=cfg.obs_path,
+            # Diagnostics knobs are output-class too: recording repro
+            # bundles or watching health changes nothing about the
+            # solve, so THIS run's flags win over the snapshot's.
+            obs_recorder=cfg.obs_recorder,
+            recorder_dir=cfg.recorder_dir,
+            health_rules=cfg.health_rules)
 
     # Built from the FINAL cfg: on resume that is the snapshot's problem +
     # constructor args, so matrix shapes always match the restored cache.

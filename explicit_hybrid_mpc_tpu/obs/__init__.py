@@ -24,6 +24,12 @@ online/sharded.py (per-shard query-latency histograms, batch sizes,
 routing counters, imbalance gauge), obs/host.py (competing-CPU
 gauges).  ``scripts/obs_report.py`` renders a run report from the
 stream and diffs it against the last BENCH_*.json.
+
+Diagnostics built on top (ISSUE 4): obs/recorder.py (flight recorder
+-- repro bundles on solver anomalies, replayed standalone by
+scripts/replay_solve.py) and obs/health.py (streaming SLO watchdog --
+health.* events, consumed in-build, by scripts/obs_watch.py, and by
+long_build's checkpoint-and-halt).
 """
 
 from __future__ import annotations
@@ -31,10 +37,14 @@ from __future__ import annotations
 import contextlib
 from typing import Optional
 
+from explicit_hybrid_mpc_tpu.obs.health import (  # noqa: F401
+    DEFAULT_RULES, HealthMonitor, rules_from_pairs)
 from explicit_hybrid_mpc_tpu.obs.host import ContentionMonitor  # noqa: F401
 from explicit_hybrid_mpc_tpu.obs.metrics import (  # noqa: F401
     DEFAULT_LATENCY_BOUNDS, Counter, Gauge, Histogram, MetricsRegistry,
     histogram_row, quantile)
+from explicit_hybrid_mpc_tpu.obs.recorder import (  # noqa: F401
+    BUNDLE_VERSION, FlightRecorder, load_bundle)
 from explicit_hybrid_mpc_tpu.obs.sink import (  # noqa: F401
     SCHEMA_VERSION, JsonlSink, json_default, load_jsonl)
 from explicit_hybrid_mpc_tpu.obs.trace import Tracer  # noqa: F401
@@ -93,9 +103,12 @@ class Obs:
             return _NULL_SPAN
         return self.tracer.span(name, **attrs)
 
-    def event(self, name: str, **fields) -> None:
+    def event(self, name: str, **fields) -> Optional[dict]:
+        """Emit one event record; returns it (callers that also feed a
+        HealthMonitor reuse the dict instead of rebuilding it)."""
         if self.enabled:
-            self.sink.emit("event", name, **fields)
+            return self.sink.emit("event", name, **fields)
+        return None
 
     # -- metrics -----------------------------------------------------------
 
@@ -109,10 +122,12 @@ class Obs:
         return (self.metrics.histogram(name, bounds) if self.enabled
                 else _NULL_METRIC)
 
-    def flush_metrics(self) -> None:
-        """Write one metrics-snapshot record to the stream."""
+    def flush_metrics(self) -> Optional[dict]:
+        """Write one metrics-snapshot record to the stream; returns it
+        (None when disabled)."""
         if self.enabled:
-            self.metrics.emit(self.sink)
+            return self.metrics.emit(self.sink)
+        return None
 
     # -- lifecycle ---------------------------------------------------------
 

@@ -1,0 +1,285 @@
+"""Streaming build-health watchdog: rolling SLO rules over obs records.
+
+A multi-hour (or multi-day, at the million-leaf north star) build can
+go *sick* long before it goes down: regions/sec stalls while the
+frontier churns, a divergence storm sends every cohort cell into phase
+2, tree warm-starts stop being accepted, one serving shard carries 10x
+the load, or a competing campaign steals the host's only core.  Each
+of those is visible in the obs stream (PR 2/3 signals) but nothing
+*watched* it -- a sick build burned its TPU allocation to the end.
+
+``HealthMonitor`` evaluates a rule set over the stream incrementally:
+feed it records (``feed``), poll it for wall-clock stall
+(``check_stall``), and it returns/emits structured ``health.*`` events
+with a severity; ``worst`` aggregates into the exit-status contract
+drivers act on (``scripts/obs_watch.py`` tails a live file;
+``scripts/long_build.py`` feeds its own checkpoint snapshots and
+checkpoint-and-halts on critical).
+
+Rule schema (all values floats; 0 disables a threshold rule):
+
+=========================  =============================================
+``stall_s``                no new record for this many wall seconds ->
+                           ``health.stall`` (critical)
+``window_steps``           build.step window for the rolling rates
+``min_regions_per_s``      rolling throughput floor ->
+                           ``health.throughput_low`` (warn)
+``max_rescue_frac``        rescue / point solve delta between metric
+                           snapshots -> ``health.rescue_storm`` (critical)
+``max_phase2_survivor_frac``  two-phase survivors gauge (divergence
+                           storm proxy) -> ``health.divergence_storm``
+                           (critical)
+``min_warmstart_accept``   accept-rate collapse (after
+                           ``min_solves_for_rates`` point solves) ->
+                           ``health.warmstart_collapse`` (warn)
+``max_shard_imbalance``    serve.shard_imbalance gauge ->
+                           ``health.shard_imbalance`` (warn)
+``max_competing_cpu_frac`` host contention gauge ->
+                           ``health.host_contended`` (warn)
+``max_device_failures``    device_failure records tolerated before
+                           ``health.device_failures`` (warn)
+``min_solves_for_rates``   rate rules stay silent below this volume
+``metrics_every_steps``    engine-side feed cadence (frontier.py)
+=========================  =============================================
+
+Overrides travel as ``(name, value)`` pairs (``cfg.health_rules``, the
+``--health-rule`` CLI flag, ``LONG_HEALTH_RULES``); unknown names raise
+-- a typo'd rule silently never firing is the failure mode this module
+exists to prevent.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Iterable, Optional
+
+DEFAULT_RULES: dict[str, float] = {
+    "stall_s": 300.0,
+    "window_steps": 50.0,
+    "min_regions_per_s": 0.0,
+    "max_rescue_frac": 0.25,
+    "max_phase2_survivor_frac": 0.95,
+    "min_warmstart_accept": 0.02,
+    "max_shard_imbalance": 8.0,
+    "max_competing_cpu_frac": 0.25,
+    "max_device_failures": 3.0,
+    "min_solves_for_rates": 2000.0,
+    "metrics_every_steps": 100.0,
+}
+
+_SEVERITY = {"ok": 0, "warn": 1, "critical": 2}
+
+
+def rules_from_pairs(pairs: Iterable[tuple[str, float]] | dict
+                     ) -> dict[str, float]:
+    """DEFAULT_RULES overridden by (name, value) pairs / a dict; raises
+    on unknown rule names (see module docstring)."""
+    out = dict(DEFAULT_RULES)
+    items = pairs.items() if isinstance(pairs, dict) else pairs
+    for k, v in items:
+        if k not in DEFAULT_RULES:
+            raise ValueError(
+                f"unknown health rule {k!r} (known: "
+                f"{', '.join(sorted(DEFAULT_RULES))})")
+        out[k] = float(v)
+    return out
+
+
+class HealthMonitor:
+    """Incremental rule evaluator (see module docstring).
+
+    Events are plain dicts ``{"name": "health.<rule>", "severity":
+    "warn"|"critical", "value": ..., "threshold": ..., "msg": ...}``;
+    when built with a sink they are ALSO emitted into the stream as
+    ``kind="event"`` records, so a health verdict is part of the run's
+    own record.  Each rule emits at most one event per `refire_after`
+    fed records (storms emit periodic reminders, not thousands of
+    duplicates); `worst` still updates on every suppressed trigger."""
+
+    def __init__(self, rules: Optional[dict] = None, sink=None,
+                 refire_after: int = 50):
+        self.rules = dict(DEFAULT_RULES)
+        if rules:
+            self.rules.update(rules_from_pairs(rules))
+        self.sink = sink
+        self.events: list[dict] = []
+        self.worst = "ok"
+        self._refire_after = refire_after
+        self._cooldown: dict[str, int] = {}
+        w = max(2, int(self.rules["window_steps"]))
+        self._steps: collections.deque[tuple[float, float]] = \
+            collections.deque(maxlen=w)
+        self._prev_counters: dict[str, float] = {}
+        self._n_device_failures = 0
+        self.n_records = 0
+        self.last_t: Optional[float] = None  # stream time of last record
+
+    # -- event plumbing ----------------------------------------------------
+
+    def _fire(self, rule: str, severity: str, value, threshold,
+              msg: str) -> Optional[dict]:
+        if _SEVERITY[severity] > _SEVERITY[self.worst]:
+            self.worst = severity
+        if self._cooldown.get(rule, 0) > 0:
+            # Still cooling down: severity updated, no event.  The
+            # cooldown is NOT refreshed here -- a persistent condition
+            # must re-notify once per refire_after records, not fall
+            # silent for the rest of the episode.
+            return None
+        self._cooldown[rule] = self._refire_after
+        ev = {"name": f"health.{rule}", "severity": severity,
+              "value": value, "threshold": threshold, "msg": msg}
+        self.events.append(ev)
+        if self.sink is not None:
+            self.sink.emit("event", ev["name"],
+                           **{k: v for k, v in ev.items() if k != "name"})
+        return ev
+
+    def _tick_cooldowns(self) -> None:
+        for k in list(self._cooldown):
+            if self._cooldown[k] > 0:
+                self._cooldown[k] -= 1
+
+    # -- feeding -----------------------------------------------------------
+
+    def feed(self, rec: dict) -> list[dict]:
+        """Evaluate one obs record; returns newly fired events."""
+        n0 = len(self.events)
+        self.n_records += 1
+        t = rec.get("t")
+        if isinstance(t, (int, float)):
+            self.last_t = float(t)
+        kind, name = rec.get("kind"), rec.get("name")
+        self._tick_cooldowns()
+        if kind == "event" and name == "build.step":
+            self._feed_step(rec)
+        elif kind == "metrics":
+            self._feed_metrics(rec)
+        elif kind == "event" and (name == "build.device_failure"
+                                  or (name == "runlog"
+                                      and "device_failure" in rec)):
+            self._n_device_failures += 1
+            lim = self.rules["max_device_failures"]
+            if self._n_device_failures > lim:
+                self._fire("device_failures", "warn",
+                           self._n_device_failures, lim,
+                           f"{self._n_device_failures} device failures "
+                           f"(> {lim:.0f}); batches run on the CPU twin")
+        return self.events[n0:]
+
+    def _feed_step(self, rec: dict) -> None:
+        t = rec.get("t")
+        regions = rec.get("regions")
+        if not isinstance(t, (int, float)) \
+                or not isinstance(regions, (int, float)):
+            return
+        self._steps.append((float(t), float(regions)))
+        floor = self.rules["min_regions_per_s"]
+        if floor <= 0 or len(self._steps) < self._steps.maxlen:
+            return
+        (t0, r0), (t1, r1) = self._steps[0], self._steps[-1]
+        if t1 <= t0:
+            return
+        rps = (r1 - r0) / (t1 - t0)
+        if rps < floor:
+            self._fire("throughput_low", "warn", round(rps, 3), floor,
+                       f"rolling throughput {rps:.2f} regions/s over the "
+                       f"last {len(self._steps)} steps (< {floor:g})")
+
+    def _feed_metrics(self, rec: dict) -> None:
+        counters = rec.get("counters", {}) or {}
+        gauges = rec.get("gauges", {}) or {}
+        min_n = self.rules["min_solves_for_rates"]
+        points = counters.get("oracle.point_solves", 0)
+
+        # Rescue storm: rescue share of point solves since the last
+        # EVALUATED snapshot (snapshots are cumulative; the delta is
+        # the window).  The baseline rolls forward only once a window
+        # reached min_n -- resetting it on every snapshot would let a
+        # low-volume snapshot cadence keep each window under the
+        # threshold forever and the rule would silently never fire.
+        d_res = (counters.get("oracle.rescue_solves", 0)
+                 - self._prev_counters.get("oracle.rescue_solves", 0))
+        d_pt = points - self._prev_counters.get("oracle.point_solves", 0)
+        lim = self.rules["max_rescue_frac"]
+        if lim > 0:
+            if d_pt >= min_n:
+                frac = d_res / d_pt
+                if frac > lim:
+                    self._fire(
+                        "rescue_storm", "critical", round(frac, 4), lim,
+                        f"rescue pass re-solved {100 * frac:.1f}% of "
+                        f"the last {d_pt} point QPs (> {100 * lim:.0f}%)"
+                        ": the configured schedule is missing broadly")
+                self._prev_counters = dict(counters)
+        else:
+            self._prev_counters = dict(counters)
+
+        lim = self.rules["max_phase2_survivor_frac"]
+        surv = gauges.get("oracle.phase2_survivor_frac")
+        if lim > 0 and surv is not None and points >= min_n \
+                and surv > lim:
+            self._fire("divergence_storm", "critical", round(surv, 4),
+                       lim,
+                       f"{100 * surv:.1f}% of two-phase cells survive "
+                       f"phase 1 unconverged (> {100 * lim:.0f}%): the "
+                       "cohort split is buying nothing / solves diverge")
+
+        lim = self.rules["min_warmstart_accept"]
+        acc = gauges.get("oracle.warmstart_accept_rate")
+        # Gated on the attempts gauge, not the rate alone: an oracle
+        # with warm_start off reports rate 0.0 forever, which is not a
+        # collapse -- nothing was ever offered to the merit gate.
+        attempts = gauges.get("oracle.warm_attempts", 0)
+        if lim > 0 and acc is not None and attempts >= min_n \
+                and acc < lim:
+            self._fire("warmstart_collapse", "warn", round(acc, 4),
+                       lim,
+                       f"tree warm-start accept rate {acc:.3f} over "
+                       f"{attempts:.0f} attempts (< {lim:g}): donors "
+                       "rejected by the merit gate; every midpoint "
+                       "starts cold")
+
+        lim = self.rules["max_shard_imbalance"]
+        imb = gauges.get("serve.shard_imbalance")
+        if lim > 0 and imb is not None and imb > lim:
+            self._fire("shard_imbalance", "warn", round(imb, 3), lim,
+                       f"serving shard imbalance {imb:.2f}x max/mean "
+                       f"(> {lim:g}): re-shard or deepen the cut")
+
+        lim = self.rules["max_competing_cpu_frac"]
+        host = gauges.get("host.competing_cpu_frac_mean")
+        if lim > 0 and host is not None and host > lim:
+            self._fire("host_contended", "warn", round(host, 3), lim,
+                       f"competing processes used {100 * host:.0f}% of "
+                       f"host CPU (> {100 * lim:.0f}%): measurements "
+                       "and the build itself are degraded")
+
+    # -- wall-clock stall --------------------------------------------------
+
+    def check_stall(self, idle_s: float) -> list[dict]:
+        """Wall-based stall check, driven by the tailer: `idle_s` is
+        how long the stream has produced NOTHING (no file growth).  A
+        frozen stream means the build is hung (device wedge, deadlock)
+        or dead without its atexit flush -- either way, critical."""
+        lim = self.rules["stall_s"]
+        if lim <= 0 or idle_s < lim:
+            return []
+        ev = self._fire("stall", "critical", round(idle_s, 1), lim,
+                        f"no obs records for {idle_s:.0f}s "
+                        f"(> {lim:.0f}s): build frozen or dead")
+        return [ev] if ev else []
+
+    # -- verdict -----------------------------------------------------------
+
+    @property
+    def exit_code(self) -> int:
+        """0 healthy, 1 warn-level findings, 2 critical (the contract
+        scripts/obs_watch.py and long_build's halt decision share)."""
+        return _SEVERITY[self.worst]
+
+    def summary(self) -> dict:
+        return {"worst": self.worst, "exit_code": self.exit_code,
+                "n_records": self.n_records,
+                "n_events": len(self.events),
+                "events": list(self.events)}

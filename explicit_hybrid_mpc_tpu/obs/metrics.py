@@ -198,6 +198,10 @@ class MetricsRegistry:
                 "histograms": {k: histogram_row(h, quantiles)
                                for k, h in snap["histograms"].items()}}
 
-    def emit(self, sink) -> None:
-        """One kind="metrics" record holding the full snapshot."""
-        sink.emit("metrics", "snapshot", **self.snapshot())
+    def emit(self, sink) -> dict:
+        """One kind="metrics" record holding the full snapshot.
+        Returns the emitted record -- the ONE producer of the
+        'metrics'/'snapshot' record shape; consumers that also feed a
+        HealthMonitor (frontier step, long_build checkpoints) reuse
+        the dict instead of re-inlining the shape."""
+        return sink.emit("metrics", "snapshot", **self.snapshot())

@@ -1,0 +1,208 @@
+"""Flight recorder: bounded ring of recent obs records + repro bundles.
+
+A diverged IPM cohort, a rescued-then-still-stuck cell, a device
+failure, or an uncertified leaf in a 12k-region build used to leave
+nothing behind but a counter -- the failure could not be reproduced or
+triaged after the run.  The FlightRecorder turns each such anomaly into
+a *versioned, compressed repro bundle* on disk: the exact solver inputs
+(canonical QP matrices, query points, warm-start iterates, schedule and
+precision flags, cell geometry) plus the last few hundred obs records
+leading up to the event.  ``scripts/replay_solve.py`` re-runs a bundle
+standalone -- no checkpoint, no problem registry, no build state -- and
+must reproduce the original converged/diverged mask bit-for-bit,
+turning any field failure into a unit-test-sized repro.
+
+Wiring: ``cfg.obs_recorder`` makes the frontier engine build one and
+point ``oracle.recorder`` at it; the obs sink's ``tap`` feeds the ring.
+Capture sites (each dumps at most ``max_bundles`` bundles per run):
+
+- ``oracle/oracle.py``: point/pair cells that end *feasible but
+  unconverged* after the full pipeline (two-phase cohort + rescue) --
+  the diverged-straggler class -- and simplex rows that return -inf
+  (no usable bound: the joint solve stalled);
+- ``partition/frontier.py``: device-failure batches (after the CPU
+  fallback resolves them, so the bundle carries the observed masks)
+  and depth-capped *uncertified leaves* (cell geometry + vertex data
+  via ``partition.certify.cell_snapshot``);
+- ``oracle/ipm.py`` contributes ``solve_mask``, the standalone replay
+  kernel the bundle's ``--kernel-only`` diagnostic path uses.
+
+Bundle format (``repro_<trigger>_<seq>.npz``, np.savez_compressed):
+one ``__meta__`` JSON string (bundle_version, trigger, kind, oracle
+schedule/precision, anomaly indices, the obs-record ring) plus flat
+numpy arrays -- ``can_*`` canonical matrices, ``thetas``/``delta_idx``
+(or ``bary_Ms`` / ``cell_verts``), optional ``warm_*`` donor iterates,
+and the observed ``obs_conv``/``obs_feas``/``obs_V`` masks replay
+compares against.  Format documented in docs/observability.md.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import threading
+import time
+from typing import Optional
+
+import numpy as np
+
+from explicit_hybrid_mpc_tpu.obs.sink import json_default
+
+#: Bumped on any incompatible change to the bundle layout or the meta
+#: fields replay_solve.py depends on.
+BUNDLE_VERSION = 1
+
+#: Canonical-matrix fields stored in every solver bundle (mirrors
+#: problems.base.CanonicalMPQP minus nothing: replay rebuilds the exact
+#: DeviceProblem from these).
+CANONICAL_FIELDS = ("H", "f", "F", "G", "w", "S", "Y", "pvec", "cconst",
+                    "u_map", "u_theta", "u_const", "deltas")
+
+
+def canonical_arrays(can) -> dict:
+    """CanonicalMPQP -> the bundle's ``can_*`` array dict."""
+    return {f"can_{k}": np.asarray(getattr(can, k))
+            for k in CANONICAL_FIELDS}
+
+
+def oracle_meta(oracle) -> dict:
+    """The solver-configuration fields replay needs to reconstruct an
+    Oracle with bit-identical semantics (same contract as
+    Oracle.cpu_twin, which the device-failure fallback already relies
+    on for bit-compatibility)."""
+    return {
+        # Class name rides for triage: bundles from subclassed kernels
+        # (PrunedOracle, SOCOracle) replay through the PLAIN Oracle --
+        # decision-identical by those classes' own exactness contracts,
+        # but not necessarily bitwise, and the report should say why.
+        "oracle_class": type(oracle).__name__,
+        "n_iter": oracle.n_iter + oracle.n_f32,
+        "precision": oracle.precision,
+        "n_f32": (oracle.n_f32 if oracle.precision == "mixed" else None),
+        "point_schedule": (list(oracle.point_schedule)
+                           if oracle.point_schedule else None),
+        "rescue_iter": oracle.rescue_iter,
+        "two_phase": oracle.two_phase,
+        "phase1_iters": oracle.phase1_iters,
+        "warm_start": oracle.warm_start,
+        "stage2_phase1_first": bool(oracle.stage2_phase1_first),
+        # Resolved per-class schedules, so the --kernel-only replay
+        # path can drive ipm.solve_mask without re-deriving the split.
+        "point_n_f32": oracle.point_n_f32,
+        "point_n_iter": oracle.point_n_iter,
+        "simplex_n_f32": oracle.n_f32,
+        "simplex_n_iter": oracle.n_iter,
+    }
+
+
+class FlightRecorder:
+    """Ring buffer of recent obs records + bundle writer (see module
+    docstring).  Thread-safe: the ring is fed from the sink's tap (any
+    emitting thread) and dumps may race between the build loop and a
+    serving thread."""
+
+    def __init__(self, out_dir: str, capacity: int = 256,
+                 max_bundles: int = 16, ring_in_bundle: int = 64,
+                 obs=None):
+        """out_dir: bundle directory (created lazily on first dump).
+        capacity: obs records kept in the ring.  max_bundles: hard cap
+        on bundles written per recorder lifetime -- an anomaly storm
+        must not fill the disk; overflow is counted, not written.
+        obs: optional obs.Obs handle; each dump emits a
+        ``recorder.bundle`` event and bumps the ``recorder.bundles``
+        counter through it."""
+        self.out_dir = out_dir
+        self.max_bundles = max_bundles
+        self.ring_in_bundle = ring_in_bundle
+        self.obs = obs
+        self.ring: collections.deque[dict] = collections.deque(
+            maxlen=capacity)
+        self.bundles: list[str] = []
+        self.n_dropped = 0
+        self._lock = threading.Lock()
+        self._seq = 0
+
+    # -- ring (sink tap) ---------------------------------------------------
+
+    def note(self, rec: dict) -> None:
+        """Sink-tap callback: remember one obs record.  Locked: dump()
+        snapshots the ring from another thread, and iterating a deque
+        while an appender mutates it raises -- which would silently
+        lose the one repro bundle the anomaly produced."""
+        with self._lock:
+            self.ring.append(rec)
+
+    # -- bundles -----------------------------------------------------------
+
+    def dump(self, trigger: str, arrays: dict, meta: dict) -> Optional[str]:
+        """Write one repro bundle; returns its path, or None when the
+        max_bundles cap already hit (the overflow is counted so the
+        run's stats still say how many anomalies occurred)."""
+        with self._lock:
+            if len(self.bundles) >= self.max_bundles:
+                self.n_dropped += 1
+                return None
+            self._seq += 1
+            seq = self._seq
+            ring = list(self.ring)[-self.ring_in_bundle:]
+        os.makedirs(self.out_dir, exist_ok=True)
+        path = os.path.join(self.out_dir,
+                            f"repro_{trigger}_{seq:03d}.npz")
+        full_meta = {"bundle_version": BUNDLE_VERSION,
+                     "trigger": trigger,
+                     "created_unix": time.time(),
+                     **meta,
+                     "ring": ring}
+        # Meta rides as a 0-d unicode array: np.load needs no pickle.
+        np.savez_compressed(
+            path,
+            __meta__=np.array(json.dumps(full_meta, default=json_default)),
+            **{k: np.asarray(v) for k, v in arrays.items()})
+        with self._lock:
+            self.bundles.append(path)
+        o = self.obs
+        if o is not None and o.enabled:
+            o.counter("recorder.bundles").inc()
+            # bundle_kind, not kind: `kind` is the record envelope's
+            # own discriminator and must not be shadowed by a field.
+            o.event("recorder.bundle", path=path, trigger=trigger,
+                    bundle_kind=meta.get("kind"))
+        return path
+
+
+def load_bundle(path: str) -> tuple[dict, dict]:
+    """(meta dict, arrays dict) from a bundle written by
+    FlightRecorder.dump.  Shared by scripts/replay_solve.py and the
+    tests; raises on a bundle_version this reader does not know."""
+    with np.load(path, allow_pickle=False) as z:
+        meta = json.loads(str(z["__meta__"][()]))
+        arrays = {k: z[k] for k in z.files if k != "__meta__"}
+    ver = meta.get("bundle_version")
+    if ver != BUNDLE_VERSION:
+        raise ValueError(f"bundle {path} has version {ver!r}; this "
+                         f"reader understands {BUNDLE_VERSION}")
+    return meta, arrays
+
+
+def rebuild_canonical(arrays: dict):
+    """Reconstruct the CanonicalMPQP a bundle's ``can_*`` arrays came
+    from (the standalone half of replay: no problem registry, no
+    constructor args -- the matrices ARE the problem)."""
+    from explicit_hybrid_mpc_tpu.problems.base import CanonicalMPQP
+
+    return CanonicalMPQP(**{k: np.asarray(arrays[f"can_{k}"])
+                            for k in CANONICAL_FIELDS})
+
+
+class BundleProblem:
+    """Minimal problem shim wrapping a rebuilt CanonicalMPQP -- exactly
+    the surface Oracle.__init__ reads (canonical + the optional
+    stage2_hint), so replay never needs the original problem class."""
+
+    def __init__(self, canonical, stage2_hint: str | None = None):
+        self.canonical = canonical
+        if stage2_hint is not None:
+            self.stage2_hint = stage2_hint
+        self.n_theta = canonical.n_theta
+        self.n_u = canonical.n_u

@@ -11,11 +11,12 @@ full schema is documented in docs/observability.md.
 
 from __future__ import annotations
 
+import atexit
 import json
 import sys
 import threading
 import time
-from typing import IO, Optional
+from typing import IO, Callable, Optional
 
 import numpy as np
 
@@ -49,25 +50,47 @@ class JsonlSink:
     def __init__(self, path: Optional[str] = None, echo: bool = False,
                  base_t: float = 0.0, keep: bool = True,
                  max_records: int = 500_000,
-                 schema_meta: bool = False):
+                 schema_meta: bool = False,
+                 tap: Optional[Callable[[dict], None]] = None):
         """base_t: cumulative elapsed seconds from PREVIOUS sessions of
         a resumed run, so the `t` column stays monotonic across an
         append boundary (see utils.logging.RunLog).  keep=False skips
         the in-memory list (multi-hour JSONL streams are millions of
         lines; file-only consumers never read it).  max_records bounds
         the in-memory list -- the FILE stream keeps everything, only
-        the memory copy stops growing (n_dropped counts the overflow)."""
+        the memory copy stops growing (n_dropped counts the overflow).
+        tap: optional callable invoked with every record dict after it
+        is written (the flight recorder's ring-buffer feed,
+        obs/recorder.py); may also be assigned later via `sink.tap`."""
         self._lock = threading.Lock()
         self._fh: Optional[IO[str]] = open(path, "a") if path else None
         self.path = path
         self._echo = echo
         self._keep = keep
         self._max_records = max_records
+        self.tap = tap
         self.records: list[dict] = []
         self.n_dropped = 0
         self.t0 = time.perf_counter() - base_t
+        # Crash-path flush: a build that dies on an uncaught exception /
+        # SystemExit unwinds the interpreter without passing through
+        # close() when the sink is not used as a context manager; the
+        # atexit hook closes (and thereby flushes) the handle so the
+        # stream's tail survives.  Unregistered again in close() so
+        # short-lived sinks do not pile up callbacks for the process
+        # lifetime.  (SIGKILL needs no handler: emit() flushes every
+        # line, so at most the record being written is lost -- and
+        # load_jsonl tolerates that truncated final line.)
+        if self._fh is not None:
+            atexit.register(self.close)
         if schema_meta:
             self.emit("meta", "schema", version=SCHEMA_VERSION)
+
+    def _unregister_atexit(self) -> None:
+        try:
+            atexit.unregister(self.close)
+        except Exception:  # interpreter tearing down
+            pass
 
     def emit(self, kind: str, name: str, **fields) -> dict:
         rec = {"t": round(time.perf_counter() - self.t0, 6),
@@ -84,6 +107,8 @@ class JsonlSink:
                 self._fh.flush()
         if self._echo:
             print(line, file=sys.stderr)
+        if self.tap is not None:
+            self.tap(rec)
         return rec
 
     def close(self) -> None:
@@ -91,6 +116,7 @@ class JsonlSink:
             if self._fh:
                 self._fh.close()
                 self._fh = None
+        self._unregister_atexit()
 
     def __enter__(self) -> "JsonlSink":
         return self
@@ -99,8 +125,30 @@ class JsonlSink:
         self.close()
 
 
-def load_jsonl(path: str) -> list[dict]:
+def load_jsonl(path: str, tolerant_tail: bool = True) -> list[dict]:
     """Parse a JSONL stream back into records (shared by
-    scripts/obs_report.py, post-processing, and the schema tests)."""
+    scripts/obs_report.py, scripts/obs_watch.py, post-processing, and
+    the schema tests).
+
+    tolerant_tail (default): a writer killed mid-record (SIGKILL, OOM)
+    leaves one truncated final line; it is silently dropped so the rest
+    of the stream stays readable -- the crashed run is exactly when the
+    stream matters most.  Corruption anywhere EARLIER still raises: a
+    mangled middle means the file itself is damaged, not merely cut
+    short."""
+    recs: list[dict] = []
+    bad_at = None
     with open(path) as f:
-        return [json.loads(ln) for ln in f if ln.strip()]
+        for ln in f:
+            if not ln.strip():
+                continue
+            if bad_at is not None:
+                raise json.JSONDecodeError(
+                    "non-final corrupt record", ln, 0)
+            try:
+                recs.append(json.loads(ln))
+            except json.JSONDecodeError:
+                if not tolerant_tail:
+                    raise
+                bad_at = ln  # tolerated only if nothing follows
+    return recs

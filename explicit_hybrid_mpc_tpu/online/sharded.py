@@ -393,8 +393,16 @@ class ShardedDescent:
                     (time.perf_counter() - seg0) / n, n=n)
         if ms:
             ms["queries"].inc(B)
-            ms["query_s"].observe(
-                (time.perf_counter() - t0) / max(B, 1), n=B)
+            wall = time.perf_counter() - t0
+            ms["query_s"].observe(wall / max(B, 1), n=B)
+            # One streaming event per evaluate() batch (never per
+            # query): gives live-stream consumers -- the health
+            # watchdog's shard-imbalance rule, scripts/obs_watch.py --
+            # a serving heartbeat between metrics snapshots.
+            self._obs.event("serve.eval", batch=B,
+                            wall_s=round(wall, 6),
+                            us_per_query=round(wall / max(B, 1) * 1e6,
+                                               3))
         return EvalResult(u=u, cost=cost, leaf=leaf, inside=inside)
 
     def _shards_n_u(self) -> int:

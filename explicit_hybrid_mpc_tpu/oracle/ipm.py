@@ -275,6 +275,32 @@ def qp_solve(Q: jax.Array, q: jax.Array, A: jax.Array, b: jax.Array,
                       warm_ok=warm_ok)
 
 
+def solve_mask(Q, q, A, b, n_iter: int = 30, n_f32: int = 0,
+               tol: float = 1e-8):
+    """Batched host-level convergence probe: run qp_solve over a batch
+    of raw QPs and return numpy ``(converged, feasible, rp)``.
+
+    This is the flight recorder's standalone *kernel-only* replay entry
+    (scripts/replay_solve.py --kernel-only): a repro bundle carries the
+    exact per-cell matrices, and this function answers "what does the
+    bare kernel say about these QPs under this schedule" without any
+    Oracle pipeline (two-phase cohorts, rescue, warm gating) in the
+    way -- the first bisection step when a replay mismatch must be
+    attributed to the kernel or to the pipeline around it.
+
+    Shapes: Q (K, nz, nz), q (K, nz), A (K, nc, nz), b (K, nc).
+    """
+    import numpy as np
+
+    fn = jax.jit(jax.vmap(
+        lambda Qk, qk, Ak, bk: qp_solve(Qk, qk, Ak, bk, n_iter=n_iter,
+                                        tol=tol, n_f32=n_f32)))
+    sol = fn(jnp.asarray(Q), jnp.asarray(q), jnp.asarray(A),
+             jnp.asarray(b))
+    return (np.asarray(sol.converged), np.asarray(sol.feasible),
+            np.asarray(sol.rp))
+
+
 def phase1(A: jax.Array, b: jax.Array, n_iter: int = 30,
            rho: float = 1e-4, n_f32: int = 0) -> jax.Array:
     """Minimal constraint violation t* = min max(A z - b) (smoothed).

@@ -78,6 +78,21 @@ class CertificateResult:
     _candidates: np.ndarray | None = None
 
 
+def cell_snapshot(sd: SimplexVertexData) -> dict[str, np.ndarray]:
+    """Canonical array packaging of one simplex's certification inputs
+    -- THE serialization repro bundles use for cell-level anomalies
+    (uncertified depth-capped leaves, obs/recorder.py).  Everything the
+    certificate read is here: replaying the vertex solves against
+    ``cell_verts`` and re-running the stage-1 certificate over this
+    snapshot reproduces the certify/split decision exactly."""
+    return {"cell_verts": np.asarray(sd.verts),
+            "obs_V": np.asarray(sd.V),
+            "obs_conv": np.asarray(sd.conv, dtype=bool),
+            "obs_grad": np.asarray(sd.grad),
+            "obs_Vstar": np.asarray(sd.Vstar),
+            "obs_dstar": np.asarray(sd.dstar, dtype=np.int64)}
+
+
 def candidate_set(sd: SimplexVertexData) -> np.ndarray:
     """Vertex-optimal commutations, deterministic ascending order
     (SURVEY.md section 4.1: candidate delta from vertex solutions)."""

@@ -28,6 +28,7 @@ cfg.checkpoint_every steps and any run can resume (SURVEY.md section 6.4).
 from __future__ import annotations
 
 import collections
+import os
 import pickle
 import time
 
@@ -117,6 +118,7 @@ class FrontierEngine:
         self._obs_t0 = time.perf_counter()
         self._prev_solves = oracle.n_solves
         self._obs_regions0 = 0
+        self._init_diagnostics()
         p = problem.n_theta
         self.tree = Tree(p=p, n_u=problem.n_u,
                          split_hyperplanes=getattr(
@@ -174,6 +176,144 @@ class FrontierEngine:
         self._inherit: dict[int, dict[int, float]] = {}
         self.n_inherited_skips = 0
 
+    # -- diagnostics: flight recorder + in-stream health monitor -----------
+
+    def _init_diagnostics(self) -> None:
+        """Build the flight recorder (cfg.obs_recorder) and the
+        in-stream health monitor (cfg.health_rules + obs enabled) --
+        shared by __init__ and resume().  Both are None by default, and
+        every hook below is guarded on that None, so the obs='off' fast
+        path gains no per-step work."""
+        self.recorder = None
+        # recorder_dir implies obs_recorder at EVERY entry point (the
+        # CLI applies the same rule): naming a bundle directory while
+        # silently recording nothing would be the worst reading.
+        if getattr(self.cfg, "obs_recorder", False) \
+                or getattr(self.cfg, "recorder_dir", None):
+            from explicit_hybrid_mpc_tpu.obs.recorder import FlightRecorder
+
+            out_dir = (getattr(self.cfg, "recorder_dir", None)
+                       or os.path.join("artifacts", "repro"))
+            self.recorder = FlightRecorder(out_dir, obs=self.obs)
+            # The sink tap feeds the recorder's ring so every bundle
+            # carries the obs records leading up to the anomaly.
+            if (self.obs.enabled and self.obs.sink is not None
+                    and self.obs.sink.tap is None):
+                self.obs.sink.tap = self.recorder.note
+            if getattr(self.oracle, "recorder", None) is None:
+                self.oracle.recorder = self.recorder
+        self._health = None
+        rules = getattr(self.cfg, "health_rules", ())
+        if self.obs.enabled and rules:
+            from explicit_hybrid_mpc_tpu.obs.health import (
+                HealthMonitor, rules_from_pairs)
+
+            self._health = HealthMonitor(rules_from_pairs(rules),
+                                         sink=self.obs.sink)
+
+    def _health_device_failure(self, e: BaseException) -> None:
+        """Record a device failure where every health consumer can see
+        it.  The RunLog record goes to cfg.log_path's SEPARATE stream,
+        which neither the in-build monitor nor an external obs_watch
+        tail reads -- without this hook the max_device_failures rule
+        silently never fires, the exact failure mode the rule
+        validation exists to prevent.  Emits a build.device_failure
+        event into the obs stream (obs_watch's input) AND feeds the
+        in-build monitor directly (obs may be off)."""
+        rec = self.obs.event("build.device_failure",
+                             error=repr(e)[:200])
+        if self._health is not None:
+            self._health.feed(rec or {"kind": "event",
+                                      "name": "build.device_failure"})
+
+    def _capture_uncertified(self, node: int, sd, res) -> None:
+        """Repro bundle for a depth-capped UNcertified leaf: the cell
+        geometry plus every vertex fact the certificate read
+        (certify.cell_snapshot) and the canonical problem, so
+        scripts/replay_solve.py can re-solve the vertices and re-run
+        stage 1 standalone."""
+        from explicit_hybrid_mpc_tpu.obs import recorder as rec_lib
+
+        self.recorder.dump(
+            "uncertified_leaf",
+            {**rec_lib.canonical_arrays(self.oracle.can),
+             **certify.cell_snapshot(sd)},
+            {"kind": "cell",
+             "oracle": rec_lib.oracle_meta(self.oracle),
+             "backend": self.oracle.backend,
+             "node": int(node), "depth": int(self.tree.depth[node]),
+             "gap": float(res.gap),
+             "eps_a": self.cfg.eps_a, "eps_r": self.cfg.eps_r})
+
+    # Device-failure bundles keep the whole failed batch (the INPUT is
+    # the repro), but bounded: beyond this many rows the bundle is a
+    # disk hazard, not a repro.
+    _MAX_FAILURE_ROWS = 4096
+
+    def _capture_device_failure(self, kind: str, args: tuple, out,
+                                err: str) -> None:
+        """Bundle a device-failed batch AFTER its CPU-fallback re-solve
+        (so the observed masks ride along): the exact batch that broke
+        the device, replayable on any host."""
+        from explicit_hybrid_mpc_tpu.obs import recorder as rec_lib
+
+        cap = self._MAX_FAILURE_ROWS
+        arrays = dict(rec_lib.canonical_arrays(self.oracle.can))
+        meta = {"oracle": rec_lib.oracle_meta(self.oracle),
+                "backend": self.oracle.backend, "error": err[:500]}
+        if kind == "vertices":
+            sol = out
+            arrays.update(thetas=np.asarray(args[0])[:cap],
+                          obs_conv=np.asarray(sol.conv, dtype=bool)[:cap],
+                          obs_feas=np.asarray(sol.feas, dtype=bool)[:cap],
+                          obs_V=np.asarray(sol.V, dtype=np.float64)[:cap])
+            meta["kind"] = "vertices"
+        else:  # pairs / pairs_full
+            arrays.update(thetas=np.asarray(args[0])[:cap],
+                          delta_idx=np.asarray(args[1],
+                                               dtype=np.int64)[:cap],
+                          obs_V=np.asarray(out[0], dtype=np.float64)[:cap],
+                          obs_conv=np.asarray(out[1], dtype=bool)[:cap])
+            if kind == "pairs_full" and args[2] is not None:
+                zw, sw, lw, hw = args[2]
+                arrays.update(warm_z=np.asarray(zw)[:cap],
+                              warm_s=np.asarray(sw)[:cap],
+                              warm_lam=np.asarray(lw)[:cap],
+                              warm_has=np.asarray(hw, dtype=bool)[:cap])
+            meta["kind"] = "pairs"
+        self.recorder.dump("device_failure", arrays, meta)
+
+    def _capture_oracle_failure(self, method: str, args: tuple, out,
+                                err: str) -> None:
+        """Device-failure bundle for the synchronous stage-2 calls
+        (_oracle_call): simplex-batch inputs + the fallback's observed
+        outputs."""
+        from explicit_hybrid_mpc_tpu.obs import recorder as rec_lib
+
+        cap = self._MAX_FAILURE_ROWS
+        arrays = dict(rec_lib.canonical_arrays(self.oracle.can))
+        arrays.update(bary_Ms=np.asarray(args[0])[:cap],
+                      delta_idx=np.asarray(args[1], dtype=np.int64)[:cap])
+        meta = {"oracle": rec_lib.oracle_meta(self.oracle),
+                "backend": self.oracle.backend, "error": err[:500]}
+        if method == "solve_simplex_min":
+            arrays.update(obs_vmin=np.asarray(out[0],
+                                              dtype=np.float64)[:cap],
+                          obs_feas_sw=np.asarray(out[1],
+                                                 dtype=bool)[:cap])
+            meta["kind"] = "simplex"
+        elif method == "simplex_feasibility":
+            arrays.update(obs_t=np.asarray(out[0],
+                                           dtype=np.float64)[:cap],
+                          obs_feas_sw=np.asarray(out[1],
+                                                 dtype=bool)[:cap],
+                          obs_infeas=np.asarray(out[2],
+                                                dtype=bool)[:cap])
+            meta["kind"] = "simplex_feas"
+        else:
+            return
+        self.recorder.dump("device_failure", arrays, meta)
+
     # -- device-failure fallback (SURVEY.md section 6.3) -------------------
 
     def _fallback_oracle(self) -> Oracle:
@@ -210,6 +350,7 @@ class FrontierEngine:
             self.n_device_failures += 1
             self.log.emit(device_failure=repr(e)[:500], query=method,
                           retry_backend="cpu")
+            self._health_device_failure(e)
             fb = self._fallback_oracle()
             before = fb.stat_snapshot()
             out = getattr(fb, method)(*args)
@@ -217,6 +358,12 @@ class FrontierEngine:
             # cohort/warm-start counters) so the exact-accounting
             # figures survive partial device fallback.
             self.oracle.fold_stats(fb, before)
+            if self.recorder is not None:
+                try:  # diagnostics must never break the fallback path
+                    self._capture_oracle_failure(method, args, out,
+                                                 repr(e))
+                except Exception:
+                    pass
             return out
         finally:
             self._oracle_s += time.perf_counter() - t0
@@ -550,6 +697,7 @@ class FrontierEngine:
             self.n_device_failures += 1
             self.log.emit(device_failure=repr(e)[:500],
                           query=f"dispatch_{kind}", retry_backend="cpu")
+            self._health_device_failure(e)
             fb = self._fallback_oracle()
             before = fb.stat_snapshot()
             if kind == "vertices":
@@ -565,6 +713,11 @@ class FrontierEngine:
             # just solve counts: the iteration ledger backs the
             # documented-exact ipm_iters/wasted_iter_frac figures.
             self.oracle.fold_stats(fb, before)
+            if self.recorder is not None:
+                try:  # diagnostics must never break the fallback path
+                    self._capture_device_failure(kind, args, out, repr(e))
+                except Exception:
+                    pass
             return out
 
     def _gather_batch(self, nodes: list[int]) -> tuple[dict, tuple]:
@@ -832,6 +985,11 @@ class FrontierEngine:
                     # UNcertified best-effort leaf, flag it in stats.
                     self.n_uncertified += 1
                     sd = sds[n]
+                    if self.recorder is not None:
+                        try:  # diagnostics must never break the build
+                            self._capture_uncertified(n, sd, res)
+                        except Exception:
+                            pass
                     d = certify.best_feasible_candidate(sd)
                     if d is not None:
                         self.tree.set_leaf(n, LeafData(
@@ -904,10 +1062,22 @@ class FrontierEngine:
                 (regions - self._obs_regions0) / max(wall, 1e-9))
             m.histogram("build.step_s").observe(step_s)
             m.histogram("build.oracle_wait_s").observe(self._oracle_s)
-            o.event("build.step", step=self.steps, regions=regions,
-                    frontier=len(self.frontier), batch=B,
-                    leaves=n_leaves, splits=n_splits,
-                    step_s=round(step_s, 6), device_frac=device_frac)
+            rec = o.event("build.step", step=self.steps, regions=regions,
+                          frontier=len(self.frontier), batch=B,
+                          leaves=n_leaves, splits=n_splits,
+                          step_s=round(step_s, 6),
+                          device_frac=device_frac)
+            if self._health is not None:
+                # In-stream watchdog (cfg.health_rules): rolling rules
+                # over the step events, plus a periodic metrics
+                # snapshot so rate rules (rescue storm, warm-start
+                # collapse) see counter deltas mid-build.  health.*
+                # events land in the SAME stream via the monitor's
+                # sink.
+                self._health.feed(rec)
+                every = int(self._health.rules["metrics_every_steps"])
+                if every > 0 and self.steps % every == 0:
+                    self._health.feed(o.flush_metrics())
 
     # -- full run ----------------------------------------------------------
 
@@ -1126,6 +1296,7 @@ class FrontierEngine:
         # work only.
         eng._prev_solves = oracle.n_solves
         eng._obs_regions0 = eng.tree.n_regions()
+        eng._init_diagnostics()
         # Rebuild the open-simplex refcounts from the restored frontier and
         # drop cache rows no open simplex references (the snapshot may
         # predate their eviction).

@@ -1,0 +1,285 @@
+"""Bench regression gate: BENCH_HISTORY.jsonl rollup + trailing-window
+comparison, the pre-merge performance check.
+
+The repo accumulates BENCH_*.json capture artifacts (bench.py), each a
+one-run snapshot; nothing compared them, so a perf regression only
+surfaced when a human eyeballed two JSONs.  This gate makes the
+trajectory first-class:
+
+1. **History**: every bench run condenses to one row (headline value,
+   online/sharded us-per-query, the iteration-economy rates, platform,
+   contention verdict) appended to ``BENCH_HISTORY.jsonl``.  ``--update``
+   rolls any BENCH_*.json not yet in the history (keyed by source name
+   + mtime, so re-running is idempotent); bench.py also appends its own
+   row at the end of every capture.
+2. **Gate**: the candidate run (newest BENCH_*.json by default, or an
+   explicit path) is compared against the trailing window of
+   same-platform, non-contended history rows, with a per-metric
+   relative tolerance and direction:
+
+   =============================  ========  ===========================
+   value (regions/s)              higher    default tol 0.10
+   online_us_per_query            lower     0.15
+   large_l_sharded_us_per_query   lower     0.15
+   wasted_iter_frac               higher    0.15
+   warmstart_accept_rate          higher    0.15
+   =============================  ========  ===========================
+
+   Exit 1 with a human-readable diff when any metric regresses beyond
+   tolerance; exit 0 otherwise.  Contended candidate captures gate
+   nothing (the number is known-bad) but say so.
+
+Usage (the documented pre-merge check, docs/perf.md):
+    python scripts/bench_gate.py --update          # roll history + gate newest
+    python scripts/bench_gate.py BENCH_r05.json    # gate a specific run
+    python scripts/bench_gate.py --tol value=0.05 --window 8
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+HISTORY = os.path.join(REPO, "BENCH_HISTORY.jsonl")
+
+#: metric name -> (direction, default relative tolerance).  Direction
+#: "higher" = bigger is better (a drop regresses); "lower" = smaller is
+#: better (a rise regresses).
+GATED_METRICS: dict[str, tuple[str, float]] = {
+    "value": ("higher", 0.10),
+    "online_us_per_query": ("lower", 0.15),
+    "large_l_sharded_us_per_query": ("lower", 0.15),
+    "wasted_iter_frac": ("higher", 0.15),
+    "warmstart_accept_rate": ("higher", 0.15),
+}
+
+_ROW_EXTRAS = ("regions", "unit", "precision", "truncated",
+               "device_failures", "uncertified")
+
+
+def summarize(bench: dict, source: str, mtime: float | None = None) -> dict:
+    """One history row from a bench result dict.
+
+    Accepts both the raw bench.py result and the driver's capture
+    wrapper ({"cmd", "rc", "tail", "parsed": <result>} -- the shape of
+    the committed BENCH_rNN.json artifacts)."""
+    if isinstance(bench.get("parsed"), dict):
+        bench = bench["parsed"]
+    row = {"source": os.path.basename(source),
+           "mtime": round(mtime, 3) if mtime is not None else None,
+           "platform": bench.get("platform"),
+           "metric": bench.get("metric"),
+           "contended": bool(bench.get("host", {}).get("contended")),
+           "error": bench.get("error")}
+    for m in GATED_METRICS:
+        row[m] = bench.get(m)
+    for k in _ROW_EXTRAS:
+        if k in bench:
+            row[k] = bench[k]
+    return row
+
+
+def load_history(path: str = HISTORY) -> list[dict]:
+    if not os.path.exists(path):
+        return []
+    rows = []
+    with open(path) as f:
+        for ln in f:
+            if ln.strip():
+                try:
+                    rows.append(json.loads(ln))
+                except json.JSONDecodeError:
+                    continue  # torn tail from a crashed appender
+    return rows
+
+
+def _seen_keys(rows: list[dict]) -> set:
+    return {(r.get("source"), r.get("mtime")) for r in rows}
+
+
+def append_history(bench: dict, source: str, path: str = HISTORY,
+                   mtime: float | None = None,
+                   seen: set | None = None) -> dict | None:
+    """Append one summarized row (skipping exact source+mtime dupes);
+    returns the row, or None when skipped.  Also the bench.py
+    end-of-run hook -- must never raise for a malformed result, so it
+    summarizes defensively.  `seen`: optional pre-loaded dedup key set
+    (updated in place); roll_history passes one so a sweep over N
+    artifacts re-reads the history once, not N times."""
+    row = summarize(bench, source, mtime)
+    if row.get("value") is None and not row.get("error"):
+        # A capture that produced neither a headline value nor an error
+        # (e.g. a driver wrapper with parsed: null) carries no gating
+        # information; recording it as a clean all-null row would
+        # pollute the history forever.
+        return None
+    if seen is None:
+        seen = _seen_keys(load_history(path))
+    key = (row["source"], row["mtime"])
+    if key in seen:
+        return None
+    with open(path, "a") as f:
+        f.write(json.dumps(row) + "\n")
+    seen.add(key)
+    return row
+
+
+def roll_history(repo_dir: str = REPO, path: str = HISTORY) -> list[dict]:
+    """Fold every BENCH_*.json in the repo root not yet summarized into
+    the history (sorted by mtime: the history reads chronologically)."""
+    added = []
+    paths = sorted(glob.glob(os.path.join(repo_dir, "BENCH_*.json")),
+                   key=os.path.getmtime)
+    seen = _seen_keys(load_history(path))
+    for p in paths:
+        try:
+            with open(p) as f:
+                bench = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            continue
+        row = append_history(bench, p, path, mtime=os.path.getmtime(p),
+                             seen=seen)
+        if row is not None:
+            added.append(row)
+    return added
+
+
+def latest_bench(repo_dir: str = REPO) -> str | None:
+    paths = sorted(glob.glob(os.path.join(repo_dir, "BENCH_*.json")),
+                   key=os.path.getmtime)
+    return paths[-1] if paths else None
+
+
+def gate(candidate: dict, history: list[dict], tol: dict | None = None,
+         window: int = 5) -> tuple[list[str], list[str]]:
+    """(regression flags, info lines) for `candidate` vs the trailing
+    `window` of comparable history rows.
+
+    Comparable = same platform, not contended, no error, not the
+    candidate itself (EVERY row sharing the candidate's source name is
+    excluded: a re-captured file overwrote the artifact its older rows
+    described, and a candidate must never sit in its own comparison
+    base), and carrying the metric.  Each metric compares against the
+    MEAN of its trailing window -- a single noisy historical run
+    cannot flip the gate the way a newest-only comparison can."""
+    tol = tol or {}
+    flags: list[str] = []
+    info: list[str] = []
+    if candidate.get("error"):
+        info.append(f"candidate carries error={candidate['error']!r}: "
+                    "nothing to gate")
+        return flags, info
+    if candidate.get("contended"):
+        info.append("candidate capture was CONTENDED: numbers are "
+                    "known-degraded, gating skipped")
+        return flags, info
+    base = [r for r in history
+            if r.get("platform") == candidate.get("platform")
+            and not r.get("contended") and not r.get("error")
+            and r.get("source") != candidate.get("source")]
+    if not base:
+        info.append(f"no comparable history rows (platform="
+                    f"{candidate.get('platform')!r}): gate vacuously "
+                    "passes -- run with --update to start the history")
+        return flags, info
+    for metric, (direction, default_tol) in GATED_METRICS.items():
+        cand = candidate.get(metric)
+        if cand is None:
+            continue
+        vals = [r[metric] for r in base[-window:]
+                if isinstance(r.get(metric), (int, float))]
+        # All-zero history (e.g. wasted_iter_frac before two-phase
+        # existed) carries no regression information.
+        vals = [v for v in vals if v != 0]
+        if not vals:
+            continue
+        ref = sum(vals) / len(vals)
+        t = tol.get(metric, default_tol)
+        delta = cand / ref - 1  # signed relative change vs the window
+        regressed = (delta < -t) if direction == "higher" else (delta > t)
+        verb = "higher" if delta >= 0 else "lower"
+        line = (f"{metric}: {cand:.4g} vs trailing-{len(vals)} mean "
+                f"{ref:.4g} ({100 * abs(delta):.1f}% {verb}, "
+                f"tol {100 * t:.0f}%)")
+        if regressed:
+            flags.append("REGRESSION " + line)
+        else:
+            info.append("ok " + line)
+    return flags, info
+
+
+def _parse_tols(pairs: list[str]) -> dict:
+    out: dict[str, float] = {}
+    for kv in pairs:
+        if "=" not in kv:
+            raise SystemExit(f"--tol needs METRIC=FRAC, got {kv!r}")
+        k, v = kv.split("=", 1)
+        if k not in GATED_METRICS:
+            raise SystemExit(f"unknown gated metric {k!r} (known: "
+                             f"{', '.join(GATED_METRICS)})")
+        out[k] = float(v)
+    return out
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("candidate", nargs="?", default=None,
+                    help="bench JSON to gate (default: newest "
+                         "BENCH_*.json in the repo root)")
+    ap.add_argument("--history", default=HISTORY,
+                    help="history path (default BENCH_HISTORY.jsonl)")
+    ap.add_argument("--update", action="store_true",
+                    help="first roll un-summarized BENCH_*.json files "
+                         "into the history")
+    ap.add_argument("--window", type=int, default=5,
+                    help="trailing history rows per metric (default 5)")
+    ap.add_argument("--tol", action="append", default=[],
+                    metavar="METRIC=FRAC",
+                    help="per-metric relative tolerance override "
+                         "(repeatable)")
+    ap.add_argument("--json", dest="json_out", default=None,
+                    help="write the structured verdict here")
+    args = ap.parse_args(argv)
+
+    if args.update:
+        added = roll_history(path=args.history)
+        print(f"history: {len(added)} new row(s) rolled into "
+              f"{os.path.basename(args.history)}", file=sys.stderr)
+
+    cand_path = args.candidate or latest_bench()
+    if cand_path is None:
+        print("no BENCH_*.json found; nothing to gate", file=sys.stderr)
+        return 0
+    with open(cand_path) as f:
+        bench = json.load(f)
+    candidate = summarize(bench, cand_path,
+                          mtime=(os.path.getmtime(cand_path)
+                                 if os.path.exists(cand_path) else None))
+    history = load_history(args.history)
+    flags, info = gate(candidate, history, tol=_parse_tols(args.tol),
+                       window=args.window)
+
+    print(f"bench gate: {os.path.basename(cand_path)} vs "
+          f"{os.path.basename(args.history)} "
+          f"({len(history)} rows, window {args.window})")
+    for line in info:
+        print("  " + line)
+    for line in flags:
+        print("  " + line)
+    if args.json_out:
+        with open(args.json_out, "w") as f:
+            json.dump({"candidate": candidate, "flags": flags,
+                       "info": info}, f, indent=2)
+    if flags:
+        print(f"GATE FAILED: {len(flags)} regression(s)")
+        return 1
+    print("GATE OK")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -25,6 +25,16 @@ inverted_pendulum), LONG_PROBLEM_ARGS (JSON dict), LONG_OUT, LONG_CKPT,
 LONG_CKPT_EVERY (steps, default 1000), LONG_BATCH, LONG_MAX_DEPTH
 (default 64), LONG_BOUNDARY_DEPTH (semi-explicit closure depth, default
 off), LONG_PRECISION (default bench.default_precision).
+
+Diagnostics (ISSUE 4): LONG_RECORDER (default 1 -- flight-recorder
+repro bundles under <artifact dir>/repro on solver anomalies;
+obs/recorder.py), LONG_HEALTH (default 1 -- a HealthMonitor evaluates
+every checkpoint's metrics snapshot and the campaign CHECKPOINT-AND-
+HALTS on a critical verdict, stop_reason="health_halt", instead of
+burning the rest of a TPU allocation on a sick build), and
+LONG_HEALTH_RULES (JSON dict of obs.health.DEFAULT_RULES overrides).
+An external terminal can additionally follow the live stream:
+``python scripts/obs_watch.py <artifact>.obs.jsonl``.
 """
 
 from __future__ import annotations
@@ -101,6 +111,16 @@ def run(result: dict, out_path: str) -> None:
         # satellite leaves in RAM and per checkpoint); they feed offline
         # soundness sampling, not the deployed controller.
         store_vertex_z=os.environ.get("LONG_STORE_Z", "1") != "0",
+        # Flight recorder: a multi-hour campaign is exactly where an
+        # unreproducible anomaly hurts most; bundles land next to the
+        # artifact.  recorder_dir must stay None when disabled -- a
+        # non-None dir IMPLIES the recorder (frontier._init_diagnostics),
+        # which would make LONG_RECORDER=0 a silent no-op.
+        obs_recorder=os.environ.get("LONG_RECORDER", "1") != "0",
+        recorder_dir=(os.path.join(os.path.dirname(out_path) or ".",
+                                   "repro")
+                      if os.environ.get("LONG_RECORDER", "1") != "0"
+                      else None),
         log_path=out_path.replace(".json", ".log.jsonl"))
     okw = dict(backend="device" if platform != "cpu" else "cpu",
                precision=precision, **sched_kw)
@@ -182,7 +202,21 @@ def run(result: dict, out_path: str) -> None:
         def wall() -> float:
             return base_wall + time.time() - t0 - paused_s
 
+        # Checkpoint-cadence health watchdog: metrics snapshots feed
+        # the same rule set scripts/obs_watch.py applies externally; a
+        # critical verdict (divergence storm, rescue storm, ...)
+        # checkpoint-and-halts the campaign instead of letting a sick
+        # build burn the remaining budget.  LONG_HEALTH=0 disables.
+        health_mon = None
+        if os.environ.get("LONG_HEALTH", "1") != "0":
+            from explicit_hybrid_mpc_tpu.obs.health import HealthMonitor
+
+            health_mon = HealthMonitor(
+                json.loads(os.environ.get("LONG_HEALTH_RULES", "{}")),
+                sink=(build_obs.sink if build_obs.enabled else None))
+
         last_ckpt_step = eng.steps
+        last_dev_failures = eng.n_device_failures
         while eng.frontier:
             regions = eng.tree.n_regions()
             if target > 0 and regions >= target:
@@ -222,8 +256,35 @@ def run(result: dict, out_path: str) -> None:
                 write_out(out_path, result)
                 # Metrics snapshot per checkpoint: the obs stream gets a
                 # resumable trajectory of counters/histograms, not just
-                # one end-of-run point.
-                build_obs.flush_metrics()
+                # one end-of-run point.  The snapshot doubles as the
+                # health monitor's rate-rule input.
+                snap_rec = build_obs.flush_metrics()  # None when off
+                if health_mon is not None:
+                    new_ev = []
+                    if snap_rec is not None:
+                        new_ev += health_mon.feed(snap_rec)
+                    new_ev += health_mon.feed({"kind": "event",
+                                               "name": "build.step",
+                                               "t": wall(),
+                                               "regions": row["regions"]})
+                    # Device failures since the last checkpoint (the
+                    # engine's obs event stream is not re-read here;
+                    # the counter delta carries the same facts).
+                    for _ in range(eng.n_device_failures
+                                   - last_dev_failures):
+                        new_ev += health_mon.feed(
+                            {"kind": "event",
+                             "name": "build.device_failure"})
+                    last_dev_failures = eng.n_device_failures
+                    for ev in new_ev:
+                        log(f"health: [{ev['severity']}] {ev['name']}: "
+                            f"{ev['msg']}")
+                    if health_mon.worst == "critical":
+                        result["stop_reason"] = "health_halt"
+                        result["health"] = health_mon.summary()
+                        log("HEALTH CRITICAL: checkpoint-and-halt "
+                            "(see result['health'])")
+                        break
                 log(f"ckpt @ step {eng.steps}: {row['regions']} regions, "
                     f"{row['frontier_left']} open, "
                     f"{row['regions_per_s']:.0f} r/s, "

@@ -124,7 +124,53 @@ def report(records: list[dict]) -> dict:
                     "serve.route_brute_queries", 0),
                 "query_s": out["histograms"].get("serve.query_s"),
             }
+
+    # -- warnings: degraded-capture signals recorded in the stream ---------
+    # (host.* gauges since PR 2, surfaced here since ISSUE 4 -- a report
+    # over a contended run must say so next to its numbers.)
+    warns: list[str] = []
+    g = out.get("gauges", {})
+    if g.get("host.contended"):
+        warns.append(
+            f"host CONTENDED: competing processes used "
+            f"{100 * g.get('host.competing_cpu_frac_mean', 0):.0f}% of "
+            f"CPU (max {100 * g.get('host.competing_cpu_frac_max', 0):.0f}"
+            "%) -- throughput and latency figures are degraded")
+    health = [r for r in records if r.get("kind") == "event"
+              and str(r.get("name", "")).startswith("health.")]
+    for r in health:
+        warns.append(f"{r['name']} [{r.get('severity')}]: "
+                     f"{r.get('msg')}")
+    n_bundles = out.get("counters", {}).get("recorder.bundles")
+    if n_bundles:
+        warns.append(f"flight recorder dumped {n_bundles} repro "
+                     "bundle(s): replay with scripts/replay_solve.py")
+    if warns:
+        out["warnings"] = warns
     return out
+
+
+def bench_warnings(bench: dict) -> list[str]:
+    """Degraded-capture signals recorded in a BENCH_*.json (probed but
+    never rendered before ISSUE 4): backend-probe failures and the
+    host contention verdict."""
+    warns: list[str] = []
+    err = bench.get("backend_probe_error")
+    if err:
+        warns.append(f"bench backend probe failed: {err}")
+    if bench.get("backend_probe_failed"):
+        warns.append("bench ran on the honest-CPU fallback "
+                     "(device backend unreachable)")
+    if bench.get("backend_init_failed"):
+        warns.append("bench backend init failed after an OK probe; "
+                     "fell back to CPU")
+    host = bench.get("host", {})
+    if host.get("contended"):
+        warns.append(
+            f"bench capture was CONTENDED: competing processes used "
+            f"{100 * host.get('competing_cpu_frac_mean', 0):.0f}% of "
+            "CPU -- its numbers are a degraded comparison base")
+    return warns
 
 
 def latest_bench(repo_dir: str = REPO) -> str | None:
@@ -238,6 +284,8 @@ def render_text(rep: dict, flags: list[str], bench_path: str | None) -> str:
                   + ("OK" if not flags else f"{len(flags)} flag(s)"))
         for f in flags:
             ln.append(f"  REGRESSION: {f}")
+    for w in rep.get("warnings", []):
+        ln.append(f"  WARNING: {w}")
     return "\n".join(ln)
 
 
@@ -251,6 +299,9 @@ def main(argv: list[str] | None = None) -> int:
                     help="also write the structured report here")
     ap.add_argument("--tol", type=float, default=0.10,
                     help="relative regression tolerance (default 0.10)")
+    ap.add_argument("--strict", action="store_true",
+                    help="exit nonzero when any bench-diff flag fires "
+                         "(CI mode)")
     args = ap.parse_args(argv)
 
     rep = report(load_jsonl(args.stream))
@@ -258,7 +309,11 @@ def main(argv: list[str] | None = None) -> int:
     flags: list[str] = []
     if bench_path and os.path.exists(bench_path):
         with open(bench_path) as f:
-            flags = diff_bench(rep, json.load(f), tol=args.tol)
+            bench = json.load(f)
+        flags = diff_bench(rep, bench, tol=args.tol)
+        rep.setdefault("warnings", []).extend(bench_warnings(bench))
+        if not rep["warnings"]:
+            del rep["warnings"]
     else:
         bench_path = None
     print(render_text(rep, flags, bench_path))
@@ -266,7 +321,7 @@ def main(argv: list[str] | None = None) -> int:
         with open(args.json_out, "w") as f:
             json.dump({"report": rep, "bench": bench_path,
                        "bench_flags": flags}, f, indent=2)
-    return 0
+    return 1 if (args.strict and flags) else 0
 
 
 if __name__ == "__main__":

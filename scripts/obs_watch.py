@@ -1,0 +1,162 @@
+"""Tail a live obs JSONL stream and watch build health (obs/health.py).
+
+The streaming counterpart of scripts/obs_report.py: where the report
+renders a FINISHED stream, this watchdog follows a LIVE one --
+``artifacts/long_build.obs.jsonl`` while the campaign runs -- feeds
+every record through the rolling SLO rules (regions/sec stall,
+divergence storm, rescue-rate threshold, warm-start acceptance
+collapse, shard imbalance, host contention), prints structured
+``health.*`` events as JSON lines on stdout, and exits with the
+monitor's verdict so drivers can act on a sick build instead of
+burning the rest of a TPU allocation:
+
+    exit 0  healthy (stream ended / --max-wall reached, no findings)
+    exit 1  warn-level findings
+    exit 2  critical findings (including health.stall: the stream
+            stopped growing for --stall-s seconds -- a frozen build)
+
+Usage:
+    python scripts/obs_watch.py RUN.obs.jsonl                # follow
+    python scripts/obs_watch.py RUN.obs.jsonl --once         # one pass
+    python scripts/obs_watch.py RUN.obs.jsonl \
+        --rule stall_s=120 --rule max_rescue_frac=0.1 --max-wall 3600
+
+``--once`` evaluates the records already in the file and exits (no
+stall detection: a finished stream is not frozen, it is finished).
+Rule schema + defaults: obs.health.DEFAULT_RULES (docs/observability.md).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from explicit_hybrid_mpc_tpu.obs.health import HealthMonitor  # noqa: E402
+from explicit_hybrid_mpc_tpu.obs.sink import load_jsonl  # noqa: E402
+
+
+def _emit(ev: dict, out) -> None:
+    print(json.dumps(ev), file=out, flush=True)
+    sev = ev.get("severity", "?")
+    print(f"[{sev.upper()}] {ev.get('name')}: {ev.get('msg')}",
+          file=sys.stderr, flush=True)
+
+
+def watch(path: str, rules: dict | None = None, interval: float = 1.0,
+          max_wall: float | None = None, once: bool = False,
+          out=None) -> tuple[int, HealthMonitor]:
+    """Drive a HealthMonitor over `path`; returns (exit_code, monitor).
+
+    Follow mode reads incrementally (tolerating a partial trailing
+    line: the writer may be mid-record) and tracks wall-clock idleness
+    for the stall rule; it returns when the stream emits a terminal
+    ``build.done`` event, on stall, or at --max-wall."""
+    if out is None:
+        out = sys.stdout  # bound at call time: test capture sees it
+    mon = HealthMonitor(rules)
+    if once:
+        for rec in load_jsonl(path):
+            for ev in mon.feed(rec):
+                _emit(ev, out)
+        return mon.exit_code, mon
+
+    t_start = time.time()
+    last_data = time.time()
+    done = False
+    buf = ""
+    fh = open(path)
+    try:
+        while True:
+            chunk = fh.read()
+            if chunk:
+                last_data = time.time()
+                buf += chunk
+                lines = buf.split("\n")
+                buf = lines.pop()  # partial tail stays buffered
+                for ln in lines:
+                    if not ln.strip():
+                        continue
+                    try:
+                        rec = json.loads(ln)
+                    except json.JSONDecodeError:
+                        continue  # torn mid-file line; skip
+                    for ev in mon.feed(rec):
+                        _emit(ev, out)
+                    if rec.get("kind") == "event" \
+                            and rec.get("name") == "build.done":
+                        done = True
+            if done:
+                break
+            for ev in mon.check_stall(time.time() - last_data):
+                _emit(ev, out)
+            if mon.worst == "critical" and any(
+                    e["name"] == "health.stall" for e in mon.events):
+                break  # a frozen stream will not unfreeze; stop burning
+            if max_wall is not None \
+                    and time.time() - t_start >= max_wall:
+                break
+            time.sleep(interval)
+    finally:
+        fh.close()
+    return mon.exit_code, mon
+
+
+def _parse_rules(pairs: list[str]) -> dict:
+    from explicit_hybrid_mpc_tpu.obs.health import rules_from_pairs
+
+    rules: dict[str, float] = {}
+    for kv in pairs:
+        if "=" not in kv:
+            raise SystemExit(f"--rule needs NAME=VALUE, got {kv!r}")
+        k, v = kv.split("=", 1)
+        try:
+            rules_from_pairs([(k, float(v))])  # the one validator
+        except ValueError as e:
+            raise SystemExit(f"--rule: {e}")
+        rules[k] = float(v)
+    return rules
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("stream", help="obs JSONL stream path")
+    ap.add_argument("--rule", action="append", default=[],
+                    metavar="NAME=VALUE",
+                    help="override a health rule (repeatable; see "
+                         "obs.health.DEFAULT_RULES)")
+    ap.add_argument("--stall-s", type=float, default=None,
+                    help="shorthand for --rule stall_s=X")
+    ap.add_argument("--interval", type=float, default=1.0,
+                    help="poll interval in follow mode (s)")
+    ap.add_argument("--max-wall", type=float, default=None,
+                    help="stop following after this many seconds")
+    ap.add_argument("--once", action="store_true",
+                    help="evaluate the existing records and exit "
+                         "(no stall detection)")
+    ap.add_argument("--json", dest="json_out", default=None,
+                    help="write the monitor summary here on exit")
+    args = ap.parse_args(argv)
+
+    rules = _parse_rules(args.rule)
+    if args.stall_s is not None:
+        rules["stall_s"] = args.stall_s
+    rc, mon = watch(args.stream, rules=rules, interval=args.interval,
+                    max_wall=args.max_wall, once=args.once)
+    summ = mon.summary()
+    print(f"obs_watch: {summ['n_records']} records, "
+          f"{summ['n_events']} health events, verdict {summ['worst']}",
+          file=sys.stderr)
+    if args.json_out:
+        with open(args.json_out, "w") as f:
+            json.dump(summ, f, indent=2)
+    return rc
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,293 @@
+"""Re-run a flight-recorder repro bundle standalone.
+
+A bundle (obs/recorder.py) carries the exact solver inputs of an
+anomaly -- canonical QP matrices, query points / simplices / cell
+geometry, warm-start iterates, IPM schedule and precision flags -- so
+this script can rebuild the identical Oracle from the bundle alone (no
+problem registry, no checkpoint, no build state) and re-issue the
+identical query.  The replay must reproduce the original
+converged/diverged mask **bit-for-bit** on the capture platform; the
+exit status says whether it did, turning any field failure into a
+unit-test-sized repro:
+
+    python scripts/replay_solve.py artifacts/repro/repro_diverged_cells_001.npz
+    python scripts/replay_solve.py BUNDLE.npz --json report.json
+    python scripts/replay_solve.py BUNDLE.npz --kernel-only   # bare-kernel probe
+
+Bundle kinds and their replay/compare contract:
+
+- ``pairs`` / ``vertices``: re-solve the captured (point, commutation)
+  cells through the full Oracle pipeline (two-phase cohort + rescue,
+  same warm starts); the converged mask must match bit-for-bit (exit 1
+  otherwise).  ``feas``/``V`` are compared too where captured (V only
+  reported when the replay backend differs from the capture backend).
+- ``simplex`` / ``simplex_feas``: re-run the stage-2 joint solves; the
+  Vmin encoding class per row (finite bound / +inf infeasible / -inf
+  stalled) and the feasibility witnesses must match.
+- ``cell``: re-solve the uncertified leaf's vertices and re-run the
+  stage-1 certificate over the SNAPSHOT.  The live build may have
+  solved these vertices with sibling warm starts the bundle cannot
+  carry (cache donors are gone by capture time), so knife-edge
+  convergence flips are possible: mismatches are reported, and gate
+  the exit status only under ``--strict-cell``.
+
+``--kernel-only`` (pairs bundles): bypass the Oracle pipeline and run
+the bare fixed-iteration kernel (ipm.solve_mask) on the realized
+per-cell matrices -- the first bisection step when a pipeline replay
+mismatches (is it the kernel or the cohort/rescue plumbing around it?).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _build_oracle(meta: dict, backend: str | None):
+    from explicit_hybrid_mpc_tpu.obs.recorder import BundleProblem
+    from explicit_hybrid_mpc_tpu.oracle.oracle import Oracle
+
+    okw = meta["oracle"]
+    cap_backend = meta.get("backend", "cpu")
+    if backend is None:
+        # Device captures replay on CPU by default (the standalone
+        # host); same-platform bit-for-bit needs the capture backend.
+        backend = cap_backend if cap_backend in ("cpu", "serial") else "cpu"
+    prob = BundleProblem(meta["_canonical"])
+    return Oracle(
+        prob, backend=backend,
+        n_iter=int(okw["n_iter"]),
+        precision=okw["precision"],
+        n_f32=okw["n_f32"],
+        point_schedule=(tuple(okw["point_schedule"])
+                        if okw["point_schedule"] else None),
+        rescue_iter=int(okw["rescue_iter"]),
+        two_phase=bool(okw["two_phase"]),
+        phase1_iters=okw["phase1_iters"],
+        warm_start=bool(okw["warm_start"]),
+        stage2_order=("phase1_first" if okw["stage2_phase1_first"]
+                      else "min_first")), backend, cap_backend
+
+
+def _mask_report(name: str, got: np.ndarray, want: np.ndarray) -> dict:
+    got = np.asarray(got, dtype=bool)
+    want = np.asarray(want, dtype=bool)
+    n_bad = int((got != want).sum())
+    return {f"{name}_match": n_bad == 0, f"{name}_mismatches": n_bad}
+
+
+def _vmin_class(v: np.ndarray) -> np.ndarray:
+    """Stage-2 encoding class per row: 0 finite bound, +1 infeasible-
+    certified (+inf), -1 no-usable-bound (-inf)."""
+    v = np.asarray(v)
+    return np.where(np.isposinf(v), 1, np.where(np.isneginf(v), -1, 0))
+
+
+def replay_bundle(path: str, backend: str | None = None,
+                  kernel_only: bool = False) -> dict:
+    """Replay one bundle; returns the structured report dict (see
+    module docstring for the per-kind contract).  report["ok"] is the
+    exit-status verdict."""
+    from explicit_hybrid_mpc_tpu.obs.recorder import (load_bundle,
+                                                      rebuild_canonical)
+
+    meta, arrays = load_bundle(path)
+    kind = meta.get("kind")
+    can = rebuild_canonical(arrays)
+    meta["_canonical"] = can
+    rep: dict = {"path": path, "kind": kind,
+                 "trigger": meta.get("trigger"),
+                 "bundle_version": meta.get("bundle_version"),
+                 "capture_backend": meta.get("backend")}
+
+    if kernel_only:
+        if kind not in ("pairs", "vertices"):
+            raise SystemExit(f"--kernel-only needs a pairs/vertices "
+                             f"bundle, got kind={kind!r}")
+        return _replay_kernel_only(rep, meta, arrays, can)
+
+    oracle, used_backend, cap_backend = _build_oracle(meta, backend)
+    rep["replay_backend"] = used_backend
+    rep["capture_oracle_class"] = meta["oracle"].get("oracle_class")
+    # Bitwise V is only claimable when the replay runs the same backend
+    # AND the same kernel class as the capture (subclassed kernels --
+    # PrunedOracle, SOCOracle -- replay through the plain Oracle:
+    # decision-identical, not bitwise).
+    same_platform = (used_backend == cap_backend
+                     and meta["oracle"].get("oracle_class",
+                                            "Oracle") == "Oracle")
+    rep["same_platform"] = same_platform
+
+    if kind == "pairs":
+        thetas = arrays["thetas"]
+        ds = arrays["delta_idx"]
+        warm = None
+        if "warm_z" in arrays:
+            warm = (arrays["warm_z"], arrays["warm_s"],
+                    arrays["warm_lam"], arrays["warm_has"])
+        V, conv, _g, _u, _z, _lam, _s = oracle.solve_pairs_full(
+            thetas, ds, warm=warm)
+        rep["n_cells"] = int(ds.shape[0])
+        rep.update(_mask_report("conv", conv, arrays["obs_conv"]))
+        ok = rep["conv_match"]
+        if "obs_feas" in arrays:
+            # feas is not part of the public pairs return; the conv
+            # mask is the replay contract (obs_feas rides for triage).
+            rep["obs_feas_true"] = int(arrays["obs_feas"].sum())
+        # Value diff over cells finite on BOTH sides only; an inf/finite
+        # disagreement is a conv flip and gets its own count -- folding
+        # it into the diff as 0.0 would report "values agree" on the
+        # very cells that disagree most.
+        V_np = np.asarray(V)
+        obs_V = arrays["obs_V"]
+        both = np.isfinite(V_np) & np.isfinite(obs_V)
+        rep["max_V_diff"] = (float(np.max(np.abs(V_np - obs_V)[both]))
+                             if both.any() else 0.0)
+        rep["V_inf_flips"] = int(
+            (np.isfinite(V_np) != np.isfinite(obs_V)).sum())
+        rep["V_bitwise"] = bool(np.array_equal(V_np, obs_V))
+        if same_platform:
+            ok = ok and rep["V_bitwise"]
+        rep["ok"] = bool(ok)
+    elif kind == "vertices":
+        sol = oracle.solve_vertices(arrays["thetas"])
+        rep["n_points"] = int(arrays["thetas"].shape[0])
+        rep.update(_mask_report("conv", sol.conv, arrays["obs_conv"]))
+        rep.update(_mask_report("feas", sol.feas, arrays["obs_feas"]))
+        rep["V_bitwise"] = bool(np.array_equal(sol.V, arrays["obs_V"]))
+        rep["ok"] = bool(rep["conv_match"] and rep["feas_match"])
+    elif kind == "simplex":
+        vmin, feas_sw = oracle.solve_simplex_min(arrays["bary_Ms"],
+                                                 arrays["delta_idx"])
+        rep["n_rows"] = int(arrays["delta_idx"].shape[0])
+        cls_got = _vmin_class(vmin)
+        cls_want = _vmin_class(arrays["obs_vmin"])
+        n_bad = int((cls_got != cls_want).sum())
+        rep["class_match"] = n_bad == 0
+        rep["class_mismatches"] = n_bad
+        rep.update(_mask_report("feas_sw", feas_sw,
+                                arrays["obs_feas_sw"]))
+        rep["vmin_bitwise"] = bool(np.array_equal(np.asarray(vmin),
+                                                  arrays["obs_vmin"]))
+        rep["ok"] = bool(rep["class_match"] and rep["feas_sw_match"])
+    elif kind == "simplex_feas":
+        t, feas_sw, infeas = oracle.simplex_feasibility(
+            arrays["bary_Ms"], arrays["delta_idx"])
+        rep["n_rows"] = int(arrays["delta_idx"].shape[0])
+        rep.update(_mask_report("feas_sw", feas_sw,
+                                arrays["obs_feas_sw"]))
+        rep.update(_mask_report("infeas", infeas, arrays["obs_infeas"]))
+        rep["max_t_diff"] = float(
+            np.max(np.abs(t - arrays["obs_t"]))) if t.size else 0.0
+        rep["ok"] = bool(rep["feas_sw_match"] and rep["infeas_match"])
+    elif kind == "cell":
+        sol = oracle.solve_vertices(arrays["cell_verts"])
+        rep["n_vertices"] = int(arrays["cell_verts"].shape[0])
+        rep.update(_mask_report("conv", sol.conv, arrays["obs_conv"]))
+        # Re-run stage 1 over the SNAPSHOT the live build certified
+        # from: the decision must reproduce exactly (it is pure host
+        # numpy over the stored arrays).
+        from explicit_hybrid_mpc_tpu.partition import certify
+
+        m, nd = arrays["obs_V"].shape
+        sd = certify.SimplexVertexData(
+            verts=arrays["cell_verts"], V=arrays["obs_V"],
+            conv=arrays["obs_conv"], grad=arrays["obs_grad"],
+            u0=np.zeros((m, nd, can.n_u)),
+            z=np.zeros((m, nd, can.nz)),
+            Vstar=arrays["obs_Vstar"], dstar=arrays["obs_dstar"])
+        res = certify.certify_suboptimal_stage1(
+            sd, meta.get("eps_a", 0.0), meta.get("eps_r", 0.0))
+        rep["snapshot_stage1_status"] = res.status
+        rep["snapshot_stage1_gap"] = (float(res.gap)
+                                      if np.isfinite(res.gap) else None)
+        rep["captured_gap"] = meta.get("gap")
+        # Cold replay vs possibly-warm-started capture: conv flips are
+        # knife-edge-possible, so the verdict is advisory by default
+        # (see module docstring); --strict-cell upgrades it.
+        rep["ok"] = True
+        rep["cell_conv_reproduced"] = rep["conv_match"]
+    else:
+        raise SystemExit(f"unknown bundle kind {kind!r} in {path}")
+    return rep
+
+
+def _replay_kernel_only(rep: dict, meta: dict, arrays: dict, can) -> dict:
+    """Bare-kernel probe on the realized per-cell QP matrices."""
+    from explicit_hybrid_mpc_tpu.oracle import ipm
+
+    okw = meta["oracle"]
+    if rep["kind"] == "pairs":
+        thetas, ds = arrays["thetas"], arrays["delta_idx"]
+    else:  # vertices: flatten the anomalous grid to pairs
+        P = arrays["thetas"].shape[0]
+        nd = can.n_delta
+        thetas = np.repeat(arrays["thetas"], nd, axis=0)
+        ds = np.tile(np.arange(nd), P)
+    K = thetas.shape[0]
+    Q = can.H[ds]
+    q = can.f[ds] + np.einsum("kij,kj->ki", can.F[ds], thetas)
+    A = can.G[ds]
+    b = can.w[ds] + np.einsum("kij,kj->ki", can.S[ds], thetas)
+    conv, feas, rp = ipm.solve_mask(
+        Q, q, A, b,
+        n_iter=int(okw["point_n_iter"]),
+        n_f32=int(okw["point_n_f32"]))
+    rep.update(kernel_only=True, n_cells=K,
+               kernel_converged=int(conv.sum()),
+               kernel_feasible=int(feas.sum()),
+               kernel_rp_max=float(np.max(rp)) if K else 0.0,
+               kernel_rp_nonfinite=int((~np.isfinite(rp)).sum()))
+    if "obs_conv" in arrays and rep["kind"] == "pairs":
+        rep.update(_mask_report("kernel_vs_obs_conv", conv,
+                                arrays["obs_conv"]))
+    rep["ok"] = True  # diagnostic mode: informational, never a gate
+    return rep
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("bundle", help="repro bundle (.npz) path")
+    ap.add_argument("--backend", default=None,
+                    choices=("cpu", "serial", "tpu", "device"),
+                    help="replay backend (default: the capture backend "
+                         "when CPU-class, else cpu)")
+    ap.add_argument("--kernel-only", action="store_true",
+                    help="bypass the Oracle pipeline; probe the bare "
+                         "fixed-iteration kernel on the realized QPs")
+    ap.add_argument("--strict-cell", action="store_true",
+                    help="gate the exit status on cell-bundle vertex "
+                         "conv reproduction too (cold replay may flip "
+                         "knife-edge cells a warm capture converged)")
+    ap.add_argument("--json", dest="json_out", default=None,
+                    help="also write the structured report here")
+    args = ap.parse_args(argv)
+
+    rep = replay_bundle(args.bundle, backend=args.backend,
+                        kernel_only=args.kernel_only)
+    if args.strict_cell and rep.get("kind") == "cell":
+        rep["ok"] = bool(rep["ok"] and rep.get("cell_conv_reproduced"))
+    for k in sorted(rep):
+        if not k.startswith("_"):
+            print(f"{k}: {rep[k]}")
+    if args.json_out:
+        with open(args.json_out, "w") as f:
+            json.dump({k: v for k, v in rep.items()
+                       if not k.startswith("_")}, f, indent=2,
+                      default=str)
+    if rep["ok"]:
+        print("REPLAY OK: observed mask reproduced")
+        return 0
+    print("REPLAY MISMATCH: observed mask NOT reproduced")
+    return 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -37,6 +37,10 @@ from bench import CACHE_DIR, cpu_cache_dir  # noqa: E402
 
 os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", CACHE_DIR)
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "2")
+# Smoke benches spawned by the suite must not append their throwaway
+# rows to the committed bench trajectory (empty string disables the
+# bench.py history hook; scripts/bench_gate.py).
+os.environ.setdefault("BENCH_HISTORY", "")
 
 import jax  # noqa: E402
 

@@ -1,0 +1,563 @@
+"""ISSUE 4 diagnostics subsystem: flight-recorder repro bundles +
+standalone replay, the streaming health watchdog, and the bench
+regression gate.
+
+The tier-1 acceptance flow lives here: a fault-injected (forced-
+divergence, via a genuinely-too-short IPM schedule) oracle under
+obs='jsonl' must produce a repro bundle during a tiny build,
+scripts/replay_solve.py must round-trip it bit-for-bit,
+scripts/obs_watch.py must raise health.stall on a frozen stream, and
+scripts/bench_gate.py must flag a synthetic >=10% regions/sec
+regression.
+"""
+
+import io
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from explicit_hybrid_mpc_tpu.config import PartitionConfig
+from explicit_hybrid_mpc_tpu.obs.health import (DEFAULT_RULES,
+                                                HealthMonitor,
+                                                rules_from_pairs)
+from explicit_hybrid_mpc_tpu.obs.recorder import (BUNDLE_VERSION,
+                                                  FlightRecorder,
+                                                  load_bundle)
+from explicit_hybrid_mpc_tpu.obs.sink import load_jsonl
+from explicit_hybrid_mpc_tpu.oracle.oracle import Oracle
+from explicit_hybrid_mpc_tpu.partition.frontier import build_partition
+from explicit_hybrid_mpc_tpu.problems.registry import make
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPTS = os.path.join(REPO, "scripts")
+
+
+def _script(name):
+    sys.path.insert(0, SCRIPTS)
+    try:
+        return __import__(name)
+    finally:
+        sys.path.pop(0)
+
+
+@pytest.fixture(scope="module")
+def prob():
+    return make("double_integrator", N=3, theta_box=1.5)
+
+
+# -- flight recorder + replay ----------------------------------------------
+
+def _short_schedule_anomaly(prob, tmp_path, n_points=24):
+    """Fault injection: a 2-iteration f64 schedule cannot converge any
+    QP, so every feasible cell ends feasible-but-unconverged -- the
+    diverged-straggler class the recorder captures."""
+    rec = FlightRecorder(str(tmp_path / "bundles"))
+    orc = Oracle(prob, backend="cpu", n_iter=2)
+    orc.recorder = rec
+    rng = np.random.default_rng(0)
+    th = rng.uniform(prob.theta_lb, prob.theta_ub,
+                     size=(n_points, prob.n_theta))
+    ds = rng.integers(0, prob.canonical.n_delta, size=n_points)
+    V, conv, *_ = orc.solve_pairs(th, ds)
+    return rec, conv
+
+
+def test_divergence_bundle_replays_bit_for_bit(prob, tmp_path):
+    rec, conv = _short_schedule_anomaly(prob, tmp_path)
+    assert not conv.any()  # the fault injection really diverges
+    assert rec.bundles, "no repro bundle produced"
+    meta, arrays = load_bundle(rec.bundles[0])
+    assert meta["bundle_version"] == BUNDLE_VERSION
+    assert meta["kind"] == "pairs"
+    assert meta["trigger"] == "diverged_cells"
+    assert meta["oracle"]["n_iter"] == 2
+    # Everything replay needs is in the bundle: canonical matrices,
+    # query, observed masks.
+    for k in ("can_H", "can_G", "thetas", "delta_idx", "obs_conv",
+              "obs_feas", "obs_V"):
+        assert k in arrays, k
+
+    replay_solve = _script("replay_solve")
+    rep = replay_solve.replay_bundle(rec.bundles[0])
+    assert rep["ok"]
+    assert rep["conv_match"] and rep["conv_mismatches"] == 0
+    assert rep["V_bitwise"]  # same platform, same kernel: bit-for-bit
+    # CLI contract: exit 0 on a reproduced mask.
+    assert replay_solve.main([rec.bundles[0]]) == 0
+
+
+def test_replay_kernel_only_probe(prob, tmp_path):
+    rec, _conv = _short_schedule_anomaly(prob, tmp_path)
+    replay_solve = _script("replay_solve")
+    rep = replay_solve.replay_bundle(rec.bundles[0], kernel_only=True)
+    assert rep["kernel_only"] and rep["ok"]
+    # The bare kernel under the same 2-iteration schedule agrees with
+    # the pipeline's observed mask (no cohort/rescue stages existed to
+    # diverge from).
+    assert rep["kernel_vs_obs_conv_match"]
+
+
+def test_recorder_ring_and_bundle_cap(tmp_path):
+    rec = FlightRecorder(str(tmp_path / "b"), capacity=4, max_bundles=1)
+    for i in range(8):
+        rec.note({"kind": "event", "name": f"e{i}"})
+    assert len(rec.ring) == 4  # bounded ring keeps the newest
+    p1 = rec.dump("t", {"x": np.zeros(2)}, {"kind": "pairs"})
+    p2 = rec.dump("t", {"x": np.zeros(2)}, {"kind": "pairs"})
+    assert p1 is not None and p2 is None
+    assert rec.n_dropped == 1
+    meta, _arrays = load_bundle(p1)
+    # The ring rides in the bundle: the obs records leading up to the
+    # anomaly are part of the repro context.
+    assert [r["name"] for r in meta["ring"]] == ["e4", "e5", "e6", "e7"]
+
+
+def test_fault_injected_build_emits_bundle_and_replays(prob, tmp_path):
+    """The CI acceptance flow: tiny build, forced-divergence oracle,
+    obs='jsonl' -> a bundle exists, the stream records it, and replay
+    round-trips it."""
+    stream = str(tmp_path / "run.obs.jsonl")
+    bdir = str(tmp_path / "repro")
+    cfg = PartitionConfig(eps_a=0.3, backend="cpu", batch_simplices=32,
+                          max_steps=40, max_depth=3, obs="jsonl",
+                          obs_path=stream, obs_recorder=True,
+                          recorder_dir=bdir)
+    oracle = Oracle(prob, backend="cpu", n_iter=2)  # forced divergence
+    res = build_partition(prob, cfg, oracle=oracle)
+    assert res.stats["uncertified"] > 0  # nothing can certify at iters=2
+
+    bundles = sorted(os.listdir(bdir))
+    assert bundles, "fault-injected build produced no repro bundle"
+    recs = load_jsonl(stream)
+    ev = [r for r in recs if r.get("name") == "recorder.bundle"]
+    assert ev, "no recorder.bundle event in the obs stream"
+    snaps = [r for r in recs if r["kind"] == "metrics"]
+    assert snaps[-1]["counters"]["recorder.bundles"] == len(ev)
+
+    replay_solve = _script("replay_solve")
+    # Replay every distinct kind produced (at least the uncertified-
+    # leaf cell bundles fire under this fault injection).
+    kinds = set()
+    for b in bundles:
+        rep = replay_solve.replay_bundle(os.path.join(bdir, b))
+        kinds.add(rep["kind"])
+        assert rep["ok"], rep
+        if rep["kind"] == "cell":
+            # The snapshot's own stage-1 decision must reproduce: the
+            # cell was depth-capped, so it cannot certify.
+            assert rep["snapshot_stage1_status"] != "certified"
+    assert "cell" in kinds
+
+
+def test_recorder_off_by_default(prob):
+    cfg = PartitionConfig(eps_a=0.5, backend="cpu", batch_simplices=32)
+    from explicit_hybrid_mpc_tpu.partition.frontier import (FrontierEngine,
+                                                            make_oracle)
+
+    eng = FrontierEngine(prob, make_oracle(prob, cfg), cfg)
+    assert eng.recorder is None and eng._health is None
+    assert eng.oracle.recorder is None
+
+
+# -- health watchdog -------------------------------------------------------
+
+def _metrics_rec(t=1.0, counters=None, gauges=None):
+    return {"t": t, "kind": "metrics", "name": "snapshot",
+            "counters": counters or {}, "gauges": gauges or {}}
+
+
+def test_health_rescue_storm_fires_on_counter_delta():
+    mon = HealthMonitor({"min_solves_for_rates": 100})
+    assert mon.feed(_metrics_rec(1.0, {"oracle.point_solves": 0,
+                                       "oracle.rescue_solves": 0})) == []
+    evs = mon.feed(_metrics_rec(
+        2.0, {"oracle.point_solves": 1000,
+              "oracle.rescue_solves": 400}))
+    assert [e["name"] for e in evs] == ["health.rescue_storm"]
+    assert mon.worst == "critical" and mon.exit_code == 2
+
+
+def test_health_divergence_storm_and_warmstart_collapse():
+    mon = HealthMonitor({"min_solves_for_rates": 100})
+    evs = mon.feed(_metrics_rec(
+        1.0, {"oracle.point_solves": 5000},
+        {"oracle.phase2_survivor_frac": 0.99,
+         "oracle.warmstart_accept_rate": 0.001,
+         "oracle.warm_attempts": 5000}))
+    names = {e["name"] for e in evs}
+    assert names == {"health.divergence_storm",
+                     "health.warmstart_collapse"}
+    assert mon.worst == "critical"
+
+
+def test_health_warmstart_rule_needs_attempts():
+    """Accept rate 0.0 with zero attempts means warm-starts are OFF,
+    not collapsed: no event."""
+    mon = HealthMonitor({"min_solves_for_rates": 100})
+    evs = mon.feed(_metrics_rec(
+        1.0, {"oracle.point_solves": 5000},
+        {"oracle.warmstart_accept_rate": 0.0,
+         "oracle.warm_attempts": 0}))
+    assert evs == []
+
+
+def test_health_shard_imbalance_and_contention_warn():
+    mon = HealthMonitor()
+    evs = mon.feed(_metrics_rec(
+        1.0, gauges={"serve.shard_imbalance": 20.0,
+                     "host.competing_cpu_frac_mean": 0.5}))
+    assert {e["name"] for e in evs} == {"health.shard_imbalance",
+                                       "health.host_contended"}
+    assert mon.worst == "warn" and mon.exit_code == 1
+
+
+def test_health_throughput_floor_and_refire_cooldown():
+    mon = HealthMonitor({"min_regions_per_s": 100.0, "window_steps": 3},
+                        refire_after=1000)
+    evs = []
+    for k in range(6):
+        evs += mon.feed({"t": float(k), "kind": "event",
+                         "name": "build.step", "regions": 10 * k})
+    assert [e["name"] for e in evs] == ["health.throughput_low"]
+    # Cooldown: the rule keeps triggering but emits one event.
+    assert len(mon.events) == 1
+
+
+def test_health_cooldown_refires_on_persistent_condition():
+    """A persistent condition re-notifies once per refire_after fed
+    records -- the cooldown must not be refreshed by suppressed
+    triggers (that would silence the rest of the episode)."""
+    mon = HealthMonitor({"max_shard_imbalance": 1.5}, refire_after=3)
+    for k in range(7):
+        mon.feed(_metrics_rec(float(k),
+                              gauges={"serve.shard_imbalance": 9.0}))
+    # Events at feeds 0, 3, 6 (cooldown 3, ticked once per feed).
+    assert len(mon.events) == 3
+
+
+def test_health_device_failure_rule():
+    mon = HealthMonitor({"max_device_failures": 0})
+    evs = mon.feed({"t": 1.0, "kind": "event", "name": "runlog",
+                    "device_failure": "XlaRuntimeError('dead tunnel')",
+                    "query": "solve_vertices"})
+    assert [e["name"] for e in evs] == ["health.device_failures"]
+
+
+def test_health_rules_validated():
+    with pytest.raises(ValueError, match="unknown health rule"):
+        rules_from_pairs([("bogus_rule", 1.0)])
+    with pytest.raises(ValueError, match="unknown health rule"):
+        PartitionConfig(health_rules=(("bogus_rule", 1.0),))
+    assert rules_from_pairs([("stall_s", 5.0)])["stall_s"] == 5.0
+    assert set(rules_from_pairs({})) == set(DEFAULT_RULES)
+
+
+def test_health_events_land_in_sink():
+    from explicit_hybrid_mpc_tpu import obs as obs_lib
+
+    o = obs_lib.Obs("jsonl")
+    mon = HealthMonitor({"max_shard_imbalance": 1.5}, sink=o.sink)
+    mon.feed(_metrics_rec(1.0, gauges={"serve.shard_imbalance": 3.0}))
+    recs = [r for r in o.sink.records
+            if r["name"] == "health.shard_imbalance"]
+    assert len(recs) == 1 and recs[0]["severity"] == "warn"
+
+
+def test_engine_in_stream_health(prob, tmp_path):
+    """cfg.health_rules + obs: the engine itself feeds the monitor and
+    health.* events land in the build's own stream."""
+    stream = str(tmp_path / "h.obs.jsonl")
+    cfg = PartitionConfig(
+        eps_a=0.5, backend="cpu", batch_simplices=32, obs="jsonl",
+        obs_path=stream,
+        # Impossible throughput floor over a tiny window: fires on any
+        # real build, proving the in-stream wiring end to end.
+        health_rules=(("min_regions_per_s", 1e9),
+                      ("window_steps", 3),
+                      ("metrics_every_steps", 2)))
+    build_partition(prob, cfg)
+    recs = load_jsonl(stream)
+    assert any(r.get("name") == "health.throughput_low" for r in recs)
+    # The periodic in-build snapshots are in the stream too (beyond the
+    # single close-time snapshot).
+    assert sum(r["kind"] == "metrics" for r in recs) >= 2
+
+
+def test_engine_feeds_device_failures_to_health(prob):
+    """The device_failure RunLog records go to the legacy stream the
+    monitor never reads; the engine must feed them directly or the
+    max_device_failures rule can never fire in-build."""
+    from explicit_hybrid_mpc_tpu.partition.frontier import (FrontierEngine,
+                                                            make_oracle)
+
+    cfg = PartitionConfig(eps_a=0.5, backend="cpu", batch_simplices=32,
+                          obs="jsonl",
+                          health_rules=(("max_device_failures", 0),))
+    eng = FrontierEngine(prob, make_oracle(prob, cfg), cfg)
+    assert eng._health is not None
+    eng._health_device_failure(RuntimeError("dead tunnel"))
+    assert [e["name"] for e in eng._health.events] == \
+        ["health.device_failures"]
+
+
+def test_obs_watch_stall_on_frozen_stream(tmp_path):
+    """Acceptance: a stream that stops growing raises health.stall and
+    the watcher exits critical."""
+    obs_watch = _script("obs_watch")
+    path = str(tmp_path / "frozen.obs.jsonl")
+    with open(path, "w") as f:
+        f.write(json.dumps({"t": 0.0, "kind": "meta", "name": "schema",
+                            "version": 1}) + "\n")
+        f.write(json.dumps({"t": 1.0, "kind": "event",
+                            "name": "build.step", "step": 1,
+                            "regions": 10}) + "\n")
+    out = io.StringIO()
+    rc, mon = obs_watch.watch(path, rules={"stall_s": 0.3},
+                              interval=0.05, max_wall=5.0, out=out)
+    assert rc == 2
+    assert any(e["name"] == "health.stall" for e in mon.events)
+    emitted = [json.loads(ln) for ln in
+               out.getvalue().strip().splitlines()]
+    assert emitted and emitted[-1]["name"] == "health.stall"
+
+
+def test_obs_watch_once_mode_healthy(tmp_path):
+    obs_watch = _script("obs_watch")
+    path = str(tmp_path / "ok.obs.jsonl")
+    with open(path, "w") as f:
+        for k in range(3):
+            f.write(json.dumps({"t": float(k), "kind": "event",
+                                "name": "build.step", "step": k,
+                                "regions": 10 * k}) + "\n")
+    rc, mon = obs_watch.watch(path, once=True, out=io.StringIO())
+    assert rc == 0 and mon.worst == "ok" and mon.n_records == 3
+
+
+def test_obs_watch_cli_once(tmp_path, capsys):
+    obs_watch = _script("obs_watch")
+    path = str(tmp_path / "bad.obs.jsonl")
+    with open(path, "w") as f:
+        f.write(json.dumps(_metrics_rec(
+            1.0, gauges={"serve.shard_imbalance": 99.0})) + "\n")
+    rc = obs_watch.main([path, "--once"])
+    assert rc == 1  # warn-level verdict
+    out = capsys.readouterr().out
+    assert "health.shard_imbalance" in out
+
+
+# -- obs_report warnings + --strict (ISSUE 4 satellites) -------------------
+
+def _mini_stream(tmp_path, gauges=None):
+    from explicit_hybrid_mpc_tpu.obs.sink import JsonlSink
+
+    path = str(tmp_path / "mini.obs.jsonl")
+    with JsonlSink(path, schema_meta=True) as s:
+        s.emit("event", "build.step", step=1, regions=100,
+               frontier=0, device_frac=0.5)
+        s.emit("metrics", "snapshot", counters={}, histograms={},
+               gauges=gauges or {})
+    return path
+
+
+def test_obs_report_renders_contention_and_probe_warnings(tmp_path,
+                                                          capsys):
+    obs_report = _script("obs_report")
+    stream = _mini_stream(tmp_path, gauges={
+        "host.contended": 1.0,
+        "host.competing_cpu_frac_mean": 0.42,
+        "host.competing_cpu_frac_max": 0.9})
+    bench_path = str(tmp_path / "BENCH_x.json")
+    with open(bench_path, "w") as f:
+        json.dump({"value": 1.0,
+                   "backend_probe_error": "probe timed out after 180s",
+                   "host": {"contended": True,
+                            "competing_cpu_frac_mean": 0.3}}, f)
+    rc = obs_report.main([stream, "--bench", bench_path])
+    out = capsys.readouterr().out
+    assert rc == 0  # no regression flags, warnings alone never gate
+    assert "WARNING" in out
+    assert "CONTENDED" in out
+    assert "probe timed out" in out
+
+
+def test_obs_report_strict_exits_nonzero_on_flags(tmp_path, capsys):
+    obs_report = _script("obs_report")
+    stream = _mini_stream(tmp_path)
+    fast_bench = str(tmp_path / "BENCH_fast.json")
+    with open(fast_bench, "w") as f:
+        json.dump({"value": 1e9}, f)  # absurdly fast bench -> regression
+    assert obs_report.main([stream, "--bench", fast_bench]) == 0
+    rc = obs_report.main([stream, "--bench", fast_bench, "--strict"])
+    assert rc == 1
+    assert "REGRESSION" in capsys.readouterr().out
+
+
+def test_obs_report_surfaces_health_events_and_bundles(tmp_path, capsys):
+    from explicit_hybrid_mpc_tpu.obs.sink import JsonlSink
+
+    obs_report = _script("obs_report")
+    path = str(tmp_path / "h.obs.jsonl")
+    with JsonlSink(path, schema_meta=True) as s:
+        s.emit("event", "health.divergence_storm", severity="critical",
+               value=0.99, threshold=0.95, msg="storm")
+        s.emit("metrics", "snapshot",
+               counters={"recorder.bundles": 2}, gauges={},
+               histograms={})
+    rc = obs_report.main([path])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "health.divergence_storm" in out
+    assert "2 repro bundle(s)" in out
+
+
+# -- bench regression gate -------------------------------------------------
+
+def _bench(value, platform="cpu", **kw):
+    return {"value": value, "platform": platform, "unit": "regions/s",
+            **kw}
+
+
+def test_bench_gate_flags_synthetic_regression(tmp_path):
+    bench_gate = _script("bench_gate")
+    hist = str(tmp_path / "BENCH_HISTORY.jsonl")
+    for i, v in enumerate([98.0, 101.0, 100.0]):
+        assert bench_gate.append_history(
+            _bench(v), f"BENCH_r{i:02d}.json", path=hist,
+            mtime=float(i)) is not None
+    history = bench_gate.load_history(hist)
+    assert len(history) == 3
+
+    # >=10% regions/sec drop: flagged (the acceptance threshold).
+    cand = bench_gate.summarize(_bench(85.0), "BENCH_new.json")
+    flags, _info = bench_gate.gate(cand, history)
+    assert any("value" in f and "REGRESSION" in f for f in flags)
+    # Within tolerance: clean.
+    cand = bench_gate.summarize(_bench(95.0), "BENCH_new.json")
+    flags, info = bench_gate.gate(cand, history)
+    assert flags == [] and any(line.startswith("ok value") for line in info)
+    # A faster run is never a regression.
+    flags, _ = bench_gate.gate(
+        bench_gate.summarize(_bench(140.0), "BENCH_new.json"), history)
+    assert flags == []
+
+
+def test_bench_gate_iteration_economy_and_latency_directions(tmp_path):
+    bench_gate = _script("bench_gate")
+    hist = str(tmp_path / "h.jsonl")
+    for i in range(3):
+        bench_gate.append_history(
+            _bench(100.0, wasted_iter_frac=0.27,
+                   warmstart_accept_rate=0.5, online_us_per_query=1.0),
+            f"BENCH_r{i:02d}.json", path=hist, mtime=float(i))
+    history = bench_gate.load_history(hist)
+    cand = bench_gate.summarize(
+        _bench(100.0, wasted_iter_frac=0.10,       # lower = worse
+               warmstart_accept_rate=0.1,          # lower = worse
+               online_us_per_query=2.0),           # higher = worse
+        "BENCH_new.json")
+    flags, _ = bench_gate.gate(cand, history)
+    flagged = {f.split()[1].rstrip(":") for f in flags}
+    assert flagged == {"wasted_iter_frac", "warmstart_accept_rate",
+                       "online_us_per_query"}
+
+
+def test_bench_gate_skips_contended_and_foreign_platform(tmp_path):
+    bench_gate = _script("bench_gate")
+    hist = str(tmp_path / "h.jsonl")
+    bench_gate.append_history(_bench(500.0, platform="tpu"),
+                              "BENCH_tpu.json", path=hist, mtime=0.0)
+    bench_gate.append_history(
+        _bench(100.0, host={"contended": True}), "BENCH_bad.json",
+        path=hist, mtime=1.0)
+    history = bench_gate.load_history(hist)
+    # Only a TPU row and a contended CPU row: no comparable base for a
+    # clean CPU candidate -> vacuous pass, explained.
+    flags, info = bench_gate.gate(
+        bench_gate.summarize(_bench(10.0), "BENCH_new.json"), history)
+    assert flags == [] and any("no comparable history" in s for s in info)
+    # A contended CANDIDATE gates nothing either.
+    flags, info = bench_gate.gate(
+        bench_gate.summarize(_bench(10.0, host={"contended": True}),
+                             "BENCH_new.json"), history)
+    assert flags == [] and any("CONTENDED" in s for s in info)
+
+
+def test_bench_gate_candidate_never_in_its_own_base(tmp_path):
+    """EVERY history row sharing the candidate's source is excluded
+    (bench.py appends a row for the capture before the gate runs; a
+    candidate compared against itself would wash out any regression)."""
+    bench_gate = _script("bench_gate")
+    hist = str(tmp_path / "h.jsonl")
+    for i in range(3):
+        bench_gate.append_history(_bench(100.0), f"BENCH_r{i:02d}.json",
+                                  path=hist, mtime=float(i))
+    # The candidate's own row, appended by bench.py with a slightly
+    # different mtime key than the gate would compute.
+    bench_gate.append_history(_bench(80.0), "BENCH_new.json",
+                              path=hist, mtime=99.0)
+    cand = bench_gate.summarize(_bench(80.0), "BENCH_new.json",
+                                mtime=99.5)
+    flags, _ = bench_gate.gate(cand, bench_gate.load_history(hist))
+    # 20% below the 100-mean window: flagged despite its own row
+    # sitting in the history under the same source name.
+    assert any(f.startswith("REGRESSION value") for f in flags)
+
+
+def test_bench_gate_skips_valueless_captures(tmp_path):
+    """A failed capture (driver wrapper with parsed: null, or a result
+    with neither value nor error) must not become a clean all-null
+    history row."""
+    bench_gate = _script("bench_gate")
+    hist = str(tmp_path / "h.jsonl")
+    assert bench_gate.append_history({"rc": 1, "parsed": None,
+                                      "tail": "boom"},
+                                     "BENCH_broken.json", path=hist) is None
+    assert bench_gate.append_history(
+        {"value": None, "platform": "cpu"}, "BENCH_void.json",
+        path=hist) is None
+    # Errored captures ARE recorded (the error field documents them and
+    # the gate's comparable filter excludes them).
+    assert bench_gate.append_history(
+        {"value": None, "error": "RuntimeError('x')"},
+        "BENCH_err.json", path=hist) is not None
+    assert len(bench_gate.load_history(hist)) == 1
+
+
+def test_recorder_dir_implies_recorder(prob, tmp_path):
+    """Naming a bundle directory activates the recorder at the config
+    layer too, not just through the CLI flag pair."""
+    from explicit_hybrid_mpc_tpu.partition.frontier import (FrontierEngine,
+                                                            make_oracle)
+
+    cfg = PartitionConfig(eps_a=0.5, backend="cpu", batch_simplices=32,
+                          recorder_dir=str(tmp_path / "b"))
+    eng = FrontierEngine(prob, make_oracle(prob, cfg), cfg)
+    assert eng.recorder is not None
+    assert eng.recorder.out_dir == str(tmp_path / "b")
+
+
+def test_bench_gate_roll_and_cli(tmp_path):
+    bench_gate = _script("bench_gate")
+    repo = tmp_path / "repo"
+    repo.mkdir()
+    for i, v in enumerate([100.0, 102.0]):
+        with open(repo / f"BENCH_r{i:02d}.json", "w") as f:
+            json.dump(_bench(v), f)
+        os.utime(repo / f"BENCH_r{i:02d}.json", (i + 1, i + 1))
+    hist = str(repo / "BENCH_HISTORY.jsonl")
+    added = bench_gate.roll_history(str(repo), hist)
+    assert [r["source"] for r in added] == ["BENCH_r00.json",
+                                           "BENCH_r01.json"]
+    assert bench_gate.roll_history(str(repo), hist) == []  # idempotent
+
+    cand = repo / "BENCH_new.json"
+    with open(cand, "w") as f:
+        json.dump(_bench(80.0), f)  # 20% down vs the 101 mean
+    rc = bench_gate.main([str(cand), "--history", hist])
+    assert rc == 1
+    with open(cand, "w") as f:
+        json.dump(_bench(99.0), f)
+    assert bench_gate.main([str(cand), "--history", hist]) == 0

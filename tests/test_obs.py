@@ -5,6 +5,8 @@ scripts/profile_capture.summarize_trace."""
 import gzip
 import json
 import os
+import signal
+import subprocess
 import sys
 import threading
 
@@ -75,6 +77,101 @@ def test_sink_thread_safe(tmp_path):
     s.close()
     recs = load_jsonl(p)
     assert len(recs) == 200 == len(s.records)
+
+
+def test_sink_tolerant_tail_drops_truncated_final_line(tmp_path):
+    """A writer killed mid-record leaves a truncated last line; the
+    stream must still parse (crashed runs are when it matters most)."""
+    p = str(tmp_path / "t.jsonl")
+    with open(p, "w") as f:
+        f.write('{"t": 1.0, "kind": "event", "name": "a"}\n')
+        f.write('{"t": 2.0, "kind": "ev')  # torn mid-record
+    recs = load_jsonl(p)
+    assert len(recs) == 1 and recs[0]["name"] == "a"
+    with pytest.raises(json.JSONDecodeError):
+        load_jsonl(p, tolerant_tail=False)
+
+
+def test_sink_corrupt_middle_still_raises(tmp_path):
+    """Tolerance covers ONLY the final line: garbage mid-file means the
+    file is damaged, not merely cut short."""
+    p = str(tmp_path / "t.jsonl")
+    with open(p, "w") as f:
+        f.write('{"t": 1.0, "kind": "ev')  # torn...
+        f.write("\n")
+        f.write('{"t": 2.0, "kind": "event", "name": "b"}\n')  # ...followed
+    with pytest.raises(json.JSONDecodeError):
+        load_jsonl(p)
+
+
+_KILLED_WRITER = """
+import os, signal, sys
+sys.path.insert(0, {repo!r})
+from explicit_hybrid_mpc_tpu.obs.sink import JsonlSink
+s = JsonlSink({path!r}, schema_meta=True)
+for i in range(10_000):
+    s.emit("event", "tick", i=i, payload="x" * 200)
+    if i == 50:
+        os.kill(os.getpid(), signal.SIGKILL)  # crash mid-stream
+"""
+
+
+def test_sink_survives_sigkilled_writer(tmp_path):
+    """Satellite (ISSUE 4): kill a writer mid-stream and the file still
+    parses -- per-record flush + tolerant-tail load."""
+    p = str(tmp_path / "killed.jsonl")
+    proc = subprocess.run(
+        [sys.executable, "-c",
+         _KILLED_WRITER.format(repo=REPO, path=p)],
+        capture_output=True, timeout=120)
+    assert proc.returncode == -signal.SIGKILL
+    recs = load_jsonl(p)  # must not raise, torn tail or not
+    assert recs[0]["name"] == "schema"
+    ticks = [r for r in recs if r["name"] == "tick"]
+    assert len(ticks) >= 50  # everything up to the kill survived
+    assert ticks[-1]["i"] == ticks[0]["i"] + len(ticks) - 1  # no holes
+
+
+_UNCLOSED_WRITER = """
+import sys
+sys.path.insert(0, {repo!r})
+from explicit_hybrid_mpc_tpu.obs.sink import JsonlSink
+s = JsonlSink({path!r}, schema_meta=True)
+for i in range(20):
+    s.emit("event", "tick", i=i)
+raise SystemExit(3)  # exits WITHOUT close(): the atexit hook must flush
+"""
+
+
+def test_sink_atexit_closes_unclosed_writer(tmp_path):
+    p = str(tmp_path / "atexit.jsonl")
+    proc = subprocess.run(
+        [sys.executable, "-c",
+         _UNCLOSED_WRITER.format(repo=REPO, path=p)],
+        capture_output=True, timeout=120)
+    assert proc.returncode == 3
+    recs = load_jsonl(p, tolerant_tail=False)  # complete, no torn tail
+    assert sum(r["name"] == "tick" for r in recs) == 20
+
+
+def test_sink_close_unregisters_atexit(tmp_path):
+    import atexit
+
+    s = JsonlSink(str(tmp_path / "s.jsonl"))
+    s.emit("event", "e")
+    s.close()
+    # Double close (context manager + atexit ordering) must be safe.
+    s.close()
+    atexit.unregister(s.close)  # no-op either way; must not raise
+
+
+def test_sink_tap_sees_every_record(tmp_path):
+    seen = []
+    s = JsonlSink(str(tmp_path / "s.jsonl"), tap=seen.append)
+    s.emit("event", "a", i=1)
+    s.emit("span", "b", wall_s=0.1)
+    s.close()
+    assert [r["name"] for r in seen] == ["a", "b"]
 
 
 # -- RunLog shim (satellite regressions) -----------------------------------
@@ -172,6 +269,75 @@ def test_histogram_quantiles_are_sane():
     assert quantile(snap, 1.0) <= snap["max"] * (1 + 1e-12)
     assert quantile({"count": 0, "bounds": [], "counts": [0],
                      "sum": 0.0, "min": None, "max": None}, 0.5) is None
+
+
+def test_quantile_empty_histogram_is_none():
+    h = Histogram()
+    snap = h.snapshot()
+    assert snap["min"] is None and snap["max"] is None
+    for q in (0.0, 0.5, 1.0):
+        assert quantile(snap, q) is None
+
+
+def test_quantile_single_bucket_mass_is_exact():
+    """All mass on one value: min == max clamp the landing bucket, so
+    every quantile is exactly that value -- no interpolation smear."""
+    h = Histogram()
+    h.observe(3.7e-4, n=1000)
+    snap = h.snapshot()
+    for q in (0.0, 0.25, 0.5, 0.99, 1.0):
+        assert quantile(snap, q) == pytest.approx(3.7e-4, rel=1e-12)
+
+
+def test_quantile_q0_q1_respect_min_max():
+    h = Histogram()
+    rng = np.random.default_rng(7)
+    vals = 10.0 ** rng.uniform(-5, -2, size=500)
+    for v in vals:
+        h.observe(float(v))
+    snap = h.snapshot()
+    assert quantile(snap, 0.0) >= snap["min"]
+    assert quantile(snap, 1.0) <= snap["max"] * (1 + 1e-12)
+    assert quantile(snap, 1.0) >= quantile(snap, 0.0)
+
+
+def test_quantile_weighted_observe_matches_numpy_reference():
+    """observe(v, n=k) must be distribution-identical to k separate
+    observes, and the estimate must track np.quantile of the expanded
+    sample within one log-bucket ratio (10^(1/5))."""
+    rng = np.random.default_rng(21)
+    vals = 10.0 ** rng.uniform(-6, -3, size=200)
+    weights = rng.integers(1, 50, size=200)
+    hw = Histogram()
+    hu = Histogram()
+    for v, n in zip(vals, weights):
+        hw.observe(float(v), n=int(n))
+        for _ in range(int(n)):
+            hu.observe(float(v))
+    sw, su = hw.snapshot(), hu.snapshot()
+    assert sw["counts"] == su["counts"] and sw["count"] == su["count"]
+    expanded = np.repeat(vals, weights)
+    bucket_ratio = 10.0 ** (1.0 / 5.0)
+    for q in (0.1, 0.5, 0.9, 0.99):
+        est = quantile(sw, q)
+        ref = float(np.quantile(expanded, q))
+        assert ref / bucket_ratio <= est <= ref * bucket_ratio, (q, est,
+                                                                 ref)
+        assert est == quantile(su, q)  # weighted == unweighted
+
+
+def test_quantile_mass_in_underflow_and_overflow_buckets():
+    """Values outside the fixed bounds land in the open-ended tail
+    cells; min/max clamping keeps the estimates finite and ordered."""
+    h = Histogram(bounds=(1e-3, 1e-2, 1e-1))
+    h.observe(1e-6, n=10)   # underflow cell
+    h.observe(5.0, n=10)    # overflow cell
+    snap = h.snapshot()
+    lo, hi = quantile(snap, 0.25), quantile(snap, 0.95)
+    assert 1e-6 <= lo <= 1e-3
+    assert 1e-1 <= hi <= 5.0
+    assert quantile(snap, 0.0) >= 1e-6
+    assert quantile(snap, 1.0) <= 5.0 * (1 + 1e-12)
 
 
 def test_registry_snapshot_and_summary():

@@ -1,0 +1,35 @@
+"""tpulint: static + runtime analysis of TPU-hostile code patterns.
+
+The failure modes that silently destroy TPU throughput -- hidden host
+syncs inside jitted regions, shape/dtype churn that triggers
+recompilation, observability emission in traced code -- were only
+detected AFTER the fact, via the bench gate (scripts/bench_gate.py) or
+the ``oracle.compiled_shapes`` gauge.  This subsystem catches them at
+lint time and at run time:
+
+- ``engine``  -- the AST rule engine: visitor framework, findings with
+  file:line + rule id + severity, per-line and per-file
+  ``# tpulint: disable=<rule>`` pragmas, JSON + human output, and a
+  checked-in ``TPULINT_BASELINE.json`` so legacy findings do not block
+  the gate while NEW ones do (scripts/tpulint.py is the CLI).
+- ``rules``   -- the initial rule pack: host-sync-in-jit,
+  recompile-hazard, dtype-discipline, obs-in-hot-loop, silent-except
+  (catalog: docs/static_analysis.md).
+- ``recompile_guard`` -- the runtime complement: a context manager
+  snapshotting the oracle's compiled-shape ledger (and/or jitted
+  functions' cache sizes) around a build phase, raising or emitting a
+  ``health.recompile`` event on unexpected lowerings
+  (cfg.recompile_guard / --recompile-guard wires it into the frontier's
+  steady-state wave loop).
+
+No module in this package imports jax or numpy at module scope: the
+engine is pure-``ast`` and the guard probes duck-typed objects
+(``compiled_shapes`` ledgers, jitted ``_cache_size``), so lint cost is
+parse-only and the guard adds no imports to the hot loop.
+"""
+
+from explicit_hybrid_mpc_tpu.analysis.engine import (  # noqa: F401
+    BASELINE_VERSION, Finding, Rule, baseline_payload, lint_paths,
+    lint_source, load_baseline, split_baselined)
+from explicit_hybrid_mpc_tpu.analysis.recompile_guard import (  # noqa: F401
+    RecompileError, RecompileGuard)

@@ -1,0 +1,389 @@
+"""The tpulint rule engine: AST visitors, findings, pragmas, baseline.
+
+Design notes:
+
+- **Jit-region index.**  Most rules only fire INSIDE code that jax will
+  trace (a host sync in plain host code is just numpy).  The engine
+  computes, once per file, the set of function nodes reachable from a
+  jit entry point: functions decorated with ``@jax.jit`` / ``@vmap`` /
+  ``@shard_map`` (including through ``functools.partial``), lambdas and
+  named functions passed to those wrappers or into body positions of
+  ``lax.fori_loop`` / ``scan`` / ``while_loop`` / ``cond``, plus a
+  same-module transitive closure over simple-name calls (a helper
+  called from a jitted lambda is traced too).  The closure is
+  name-based and module-local -- deliberately: cross-module dataflow
+  would need real type inference, and the kernels this repo cares
+  about (oracle/ipm.py, online/) keep their traced helpers in-module.
+- **Pragmas.**  ``# tpulint: disable=<rule>[,<rule>...]`` trailing a
+  code line suppresses those rules on that line; the same pragma on a
+  comment-only line suppresses them for the whole file.  Anything
+  after the rule list (``-- reason``) is the human justification the
+  review policy requires.  ``disable=all`` suppresses every rule.
+  ``# tpulint: x32-module`` on a comment-only line tags the file as an
+  f32 kernel module for the dtype-discipline rule.
+- **Baseline.**  ``TPULINT_BASELINE.json`` holds a multiset of
+  (file, rule, stripped-source-line) keys: legacy findings matched by
+  CONTENT, not line number, so unrelated edits do not resurrect them,
+  while genuinely new findings always gate.  ``scripts/tpulint.py
+  --update-baseline`` rewrites it from the current findings.
+
+The module is pure ``ast`` + stdlib (no jax/numpy): see the package
+docstring.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+import re
+from collections import Counter
+from typing import Iterable, Iterator, Optional
+
+BASELINE_VERSION = 1
+
+SEVERITIES = ("error", "warn")
+
+#: wrappers whose FIRST argument (or decorated function) is traced.
+_JIT_WRAPPERS = {"jit", "vmap", "pmap", "shard_map", "named_call"}
+#: control-flow combinators -> indices of their traced function args.
+_BODY_WRAPPERS = {
+    "fori_loop": (2,),
+    "while_loop": (0, 1),
+    "scan": (0,),
+    "cond": (1, 2),
+    "switch": (),          # branches arrive as a list; handled inline
+    "map": (0,),
+    "associative_scan": (0,),
+}
+
+_PRAGMA = re.compile(r"#\s*tpulint:\s*(disable|x32-module)\b\s*(?:=\s*(.*))?")
+
+
+def _pragma_rules(raw: Optional[str]) -> set[str]:
+    """Rule ids from a pragma value, tolerating a trailing freeform
+    justification after each id (``disable=silent-except -- why``)."""
+    out = set()
+    for tok in (raw or "").split(","):
+        tok = tok.strip()
+        if tok:
+            out.add(tok.split()[0])
+    return out
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One rule violation, anchored to file:line:col.
+
+    ``code`` is the stripped source line -- the content-addressed key
+    the baseline matches on (line numbers churn; code lines rarely do
+    without the finding itself changing)."""
+
+    rule: str
+    severity: str
+    path: str
+    line: int
+    col: int
+    msg: str
+    code: str
+
+    @property
+    def key(self) -> tuple[str, str, str]:
+        return (self.path, self.rule, self.code)
+
+    def render(self) -> str:
+        return (f"{self.path}:{self.line}:{self.col}: "
+                f"[{self.rule}] {self.severity}: {self.msg}")
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class Rule:
+    """Base class for lint rules.
+
+    Subclasses set ``name`` / ``severity`` / ``doc`` and implement
+    ``check(ctx)`` yielding findings; ``finding(ctx, node, msg)`` fills
+    in the location + source-line plumbing."""
+
+    name: str = "abstract"
+    severity: str = "warn"
+    doc: str = ""
+
+    def check(self, ctx: "ModuleContext") -> Iterator[Finding]:
+        raise NotImplementedError
+
+    def finding(self, ctx: "ModuleContext", node: ast.AST, msg: str,
+                severity: Optional[str] = None) -> Finding:
+        line = getattr(node, "lineno", 1)
+        col = getattr(node, "col_offset", 0)
+        code = (ctx.lines[line - 1].strip()
+                if 0 < line <= len(ctx.lines) else "")
+        return Finding(rule=self.name, severity=severity or self.severity,
+                       path=ctx.rel, line=line, col=col, msg=msg,
+                       code=code)
+
+
+def _attr_chain(node: ast.AST) -> list[str]:
+    """['jax', 'lax', 'fori_loop'] for jax.lax.fori_loop; [] when the
+    expression is not a plain name/attribute chain."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return parts[::-1]
+    return []
+
+
+def _call_name(node: ast.AST) -> str:
+    """Last segment of a call target's name chain ('' if unnameable)."""
+    chain = _attr_chain(node)
+    return chain[-1] if chain else ""
+
+
+def _unwrap_partial(node: ast.AST) -> ast.AST:
+    """functools.partial(f, ...) -> f (jit(partial(fn, ...)) traces fn)."""
+    if isinstance(node, ast.Call) and _call_name(node.func) == "partial" \
+            and node.args:
+        return node.args[0]
+    return node
+
+
+class _JitIndex:
+    """The per-module set of function nodes jax will trace (see module
+    docstring for what is and is not covered)."""
+
+    def __init__(self, tree: ast.Module):
+        self.marked: set[ast.AST] = set()
+        # Every def in the module by simple name (scope-insensitive on
+        # purpose: marking one extra same-named helper costs a lint
+        # false positive at worst, missing one hides a real host sync).
+        self._defs: dict[str, list[ast.AST]] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._defs.setdefault(node.name, []).append(node)
+        self._seed(tree)
+        self._close(tree)
+
+    def _mark_expr(self, node: ast.AST) -> None:
+        node = _unwrap_partial(node)
+        if isinstance(node, ast.Lambda):
+            self.marked.add(node)
+        elif isinstance(node, ast.Name):
+            for d in self._defs.get(node.id, ()):
+                self.marked.add(d)
+
+    def _seed(self, tree: ast.Module) -> None:
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    target = dec.func if isinstance(dec, ast.Call) else dec
+                    target = _unwrap_partial(target) if isinstance(
+                        dec, ast.Call) else target
+                    name = _call_name(target)
+                    if name in _JIT_WRAPPERS:
+                        self.marked.add(node)
+                    elif name == "partial" and isinstance(dec, ast.Call) \
+                            and dec.args \
+                            and _call_name(dec.args[0]) in _JIT_WRAPPERS:
+                        # @functools.partial(jax.jit, static_argnums=..)
+                        self.marked.add(node)
+            elif isinstance(node, ast.Call):
+                name = _call_name(node.func)
+                if name in _JIT_WRAPPERS and node.args:
+                    self._mark_expr(node.args[0])
+                elif name in _BODY_WRAPPERS:
+                    for i in _BODY_WRAPPERS[name]:
+                        if i < len(node.args):
+                            self._mark_expr(node.args[i])
+                    if name == "switch" and len(node.args) > 1 and \
+                            isinstance(node.args[1], (ast.List, ast.Tuple)):
+                        for el in node.args[1].elts:
+                            self._mark_expr(el)
+
+    def _close(self, tree: ast.Module) -> None:
+        """Fixpoint: helpers CALLED by simple name from a marked
+        function are traced too."""
+        changed = True
+        while changed:
+            changed = False
+            for fn in list(self.marked):
+                for node in ast.walk(fn):
+                    if isinstance(node, ast.Call) \
+                            and isinstance(node.func, ast.Name):
+                        for d in self._defs.get(node.func.id, ()):
+                            if d not in self.marked:
+                                self.marked.add(d)
+                                changed = True
+
+
+class ModuleContext:
+    """Everything rules need about one file, computed once: AST, parent
+    links, the jit-region index, pragma tables, and source lines."""
+
+    def __init__(self, path: str, source: str, rel: Optional[str] = None):
+        self.path = path
+        self.rel = rel or path
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        self.parents: dict[ast.AST, ast.AST] = {}
+        for parent in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(parent):
+                self.parents[child] = parent
+        self._jit = _JitIndex(self.tree)
+        self.jit_funcs = self._jit.marked
+        self.line_disables: dict[int, set[str]] = {}
+        self.file_disables: set[str] = set()
+        self.x32_module = False
+        self._scan_pragmas()
+
+    def _scan_pragmas(self) -> None:
+        for i, line in enumerate(self.lines, start=1):
+            m = _PRAGMA.search(line)
+            if not m:
+                continue
+            comment_only = line.strip().startswith("#")
+            if m.group(1) == "x32-module":
+                if comment_only:
+                    self.x32_module = True
+                continue
+            rules = _pragma_rules(m.group(2))
+            if comment_only:
+                self.file_disables |= rules
+            else:
+                self.line_disables.setdefault(i, set()).update(rules)
+
+    # -- queries rules use -------------------------------------------------
+
+    def in_jit(self, node: ast.AST) -> bool:
+        """True when any enclosing function scope (including `node`
+        itself) is jit-traced."""
+        cur: Optional[ast.AST] = node
+        while cur is not None:
+            if cur in self.jit_funcs:
+                return True
+            cur = self.parents.get(cur)
+        return False
+
+    def enclosing_function(self, node: ast.AST) -> Optional[ast.AST]:
+        cur = self.parents.get(node)
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda)):
+                return cur
+            cur = self.parents.get(cur)
+        return None
+
+    def suppressed(self, f: Finding) -> bool:
+        if {"all", f.rule} & self.file_disables:
+            return True
+        line_rules = self.line_disables.get(f.line, set())
+        return bool({"all", f.rule} & line_rules)
+
+
+# -- linting entry points --------------------------------------------------
+
+def _default_rules() -> list[Rule]:
+    from explicit_hybrid_mpc_tpu.analysis.rules import all_rules
+
+    return all_rules()
+
+
+def lint_source(source: str, path: str, rules: Iterable[Rule] | None = None,
+                rel: Optional[str] = None) -> list[Finding]:
+    """Lint one source string; a syntax error becomes a single
+    ``parse-error`` finding rather than an exception (the gate must
+    report a broken file, not crash on it)."""
+    rules = list(rules) if rules is not None else _default_rules()
+    try:
+        ctx = ModuleContext(path, source, rel=rel)
+    except SyntaxError as e:
+        return [Finding(rule="parse-error", severity="error",
+                        path=rel or path, line=e.lineno or 1,
+                        col=e.offset or 0, msg=f"cannot parse: {e.msg}",
+                        code="")]
+    out: list[Finding] = []
+    for rule in rules:
+        for f in rule.check(ctx):
+            if not ctx.suppressed(f):
+                out.append(f)
+    out.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return out
+
+
+def lint_paths(paths: Iterable[str], rules: Iterable[Rule] | None = None,
+               root: Optional[str] = None) -> list[Finding]:
+    """Lint files and/or directory trees (``*.py``); finding paths are
+    recorded relative to ``root`` (default: cwd) so baseline keys stay
+    stable across checkouts."""
+    rules = list(rules) if rules is not None else _default_rules()
+    root = os.path.abspath(root or os.getcwd())
+    files: list[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = sorted(d for d in dirnames
+                                     if not d.startswith((".", "__pycache")))
+                files.extend(os.path.join(dirpath, f)
+                             for f in sorted(filenames)
+                             if f.endswith(".py"))
+        else:
+            files.append(p)
+    out: list[Finding] = []
+    for fp in files:
+        with open(fp, encoding="utf-8") as fh:
+            src = fh.read()
+        rel = os.path.relpath(os.path.abspath(fp), root)
+        out.extend(lint_source(src, fp, rules, rel=rel))
+    out.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return out
+
+
+# -- baseline --------------------------------------------------------------
+
+def baseline_payload(findings: Iterable[Finding]) -> dict:
+    """The serializable baseline: a sorted multiset of finding keys."""
+    counts = Counter(f.key for f in findings)
+    rows = [{"file": k[0], "rule": k[1], "code": k[2], "count": n}
+            for k, n in sorted(counts.items())]
+    return {"version": BASELINE_VERSION, "tool": "tpulint",
+            "findings": rows}
+
+
+def load_baseline(path: str) -> Counter:
+    """Baseline file -> Counter of (file, rule, code) keys.  A missing
+    file is an empty baseline (everything gates)."""
+    if not os.path.exists(path):
+        return Counter()
+    with open(path, encoding="utf-8") as fh:
+        data = json.load(fh)
+    if data.get("version") != BASELINE_VERSION:
+        raise ValueError(
+            f"baseline {path} has version {data.get('version')!r}; this "
+            f"engine writes v{BASELINE_VERSION} -- regenerate it with "
+            "scripts/tpulint.py --update-baseline")
+    out: Counter = Counter()
+    for row in data.get("findings", []):
+        out[(row["file"], row["rule"], row["code"])] += int(
+            row.get("count", 1))
+    return out
+
+
+def split_baselined(findings: Iterable[Finding], baseline: Counter
+                    ) -> tuple[list[Finding], list[Finding]]:
+    """(new, baselined): each baseline entry absolves at most `count`
+    matching findings -- a key's N+1'th occurrence is NEW and gates."""
+    budget = Counter(baseline)
+    new: list[Finding] = []
+    old: list[Finding] = []
+    for f in findings:
+        if budget[f.key] > 0:
+            budget[f.key] -= 1
+            old.append(f)
+        else:
+            new.append(f)
+    return new, old

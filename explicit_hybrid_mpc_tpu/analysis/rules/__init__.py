@@ -1,0 +1,27 @@
+"""The tpulint rule pack (catalog + rationale: docs/static_analysis.md).
+
+Each module holds one rule class; ``all_rules()`` is the registry the
+engine and the CLI share.  Adding a rule = adding a module here and
+listing it below -- the CLI's ``--list-rules`` / ``--rules`` and the
+tier-1 package-clean test pick it up automatically.
+"""
+
+from __future__ import annotations
+
+from explicit_hybrid_mpc_tpu.analysis.engine import Rule
+from explicit_hybrid_mpc_tpu.analysis.rules.dtype import DtypeDiscipline
+from explicit_hybrid_mpc_tpu.analysis.rules.host_sync import HostSyncInJit
+from explicit_hybrid_mpc_tpu.analysis.rules.obs_hot import ObsInHotLoop
+from explicit_hybrid_mpc_tpu.analysis.rules.recompile import RecompileHazard
+from explicit_hybrid_mpc_tpu.analysis.rules.silent_except import SilentExcept
+
+_RULE_CLASSES = (HostSyncInJit, RecompileHazard, DtypeDiscipline,
+                 ObsInHotLoop, SilentExcept)
+
+
+def all_rules() -> list[Rule]:
+    return [cls() for cls in _RULE_CLASSES]
+
+
+def rules_by_name() -> dict[str, Rule]:
+    return {r.name: r for r in all_rules()}

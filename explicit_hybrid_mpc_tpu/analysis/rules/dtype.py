@@ -1,0 +1,86 @@
+"""dtype-discipline: width-ambiguous and f64-leaking dtypes.
+
+Two sub-checks:
+
+1. **builtin-dtype cast** (everywhere): ``x.astype(float)`` /
+   ``dtype=float`` (and ``int``) resolve through Python's builtins,
+   whose array width depends on the platform and the
+   ``jax_enable_x64`` flag -- the same line means f64 on this repo's
+   host path and f32 inside an x32 context.  Name the width:
+   ``np.float64``, ``jnp.float32``, or the source array's ``.dtype``.
+   (``bool`` is exempt: one width, idiomatic numpy.)
+2. **f64 in x32 modules** (files tagged ``# tpulint: x32-module``):
+   ``np.float64`` / ``jnp.float64`` / ``dtype='float64'`` literals in a
+   module declared to hold f32 kernel code.  One f64 constant folded
+   into an otherwise-f32 TPU kernel upcasts the whole expression chain
+   into emulated-f64 territory (~10x per op) -- exactly the leak the
+   mixed-precision schedule exists to avoid.  This repo's modules are
+   f64-first by policy (IPMs need it), so no file is tagged today; the
+   tag is the opt-in for future x32 kernel modules (and the fixture
+   tests exercise it).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from explicit_hybrid_mpc_tpu.analysis.engine import (Finding, ModuleContext,
+                                                     Rule, _attr_chain)
+
+# float/int only: their array width depends on the platform and the
+# x64 flag.  `bool` is deliberately NOT here -- np.bool_ has exactly
+# one width, so dtype=bool is idiomatic numpy, not a hazard.
+_BUILTIN_DTYPES = {"float", "int"}
+_F64_NAMES = {"float64", "double"}
+
+
+class DtypeDiscipline(Rule):
+    name = "dtype-discipline"
+    severity = "warn"
+    doc = ("builtin-dtype casts (astype(float), dtype=int) whose width "
+           "depends on platform/x64 flag; f64 literals in x32-tagged "
+           "kernel modules")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call):
+                yield from self._check_call(ctx, node)
+            if ctx.x32_module and isinstance(node, ast.Attribute) \
+                    and node.attr in _F64_NAMES:
+                chain = _attr_chain(node)
+                yield self.finding(
+                    ctx, node,
+                    f"{'.'.join(chain) or node.attr} in an x32-tagged "
+                    "kernel module: one f64 constant upcasts the traced "
+                    "expression chain into emulated f64 on TPU")
+
+    def _check_call(self, ctx: ModuleContext, node: ast.Call
+                    ) -> Iterator[Finding]:
+        fn = node.func
+        if isinstance(fn, ast.Attribute) and fn.attr == "astype" \
+                and node.args:
+            a = node.args[0]
+            if isinstance(a, ast.Name) and a.id in _BUILTIN_DTYPES:
+                yield self.finding(
+                    ctx, node,
+                    f".astype({a.id}) resolves through the Python "
+                    "builtin: width depends on platform and the x64 "
+                    "flag; name it (np.float64 / jnp.float32 / "
+                    "other.dtype)")
+        for kw in node.keywords:
+            if kw.arg == "dtype":
+                v = kw.value
+                if isinstance(v, ast.Name) and v.id in _BUILTIN_DTYPES:
+                    yield self.finding(
+                        ctx, v,
+                        f"dtype={v.id} resolves through the Python "
+                        "builtin: width depends on platform and the x64 "
+                        "flag; name it explicitly")
+                elif ctx.x32_module and isinstance(v, ast.Constant) \
+                        and isinstance(v.value, str) \
+                        and v.value in _F64_NAMES:
+                    yield self.finding(
+                        ctx, v,
+                        f"dtype='{v.value}' in an x32-tagged kernel "
+                        "module leaks emulated f64 into the kernel")

@@ -1,0 +1,88 @@
+"""host-sync-in-jit: host round-trips inside jit-traced code.
+
+``float(x)`` / ``int(x)`` / ``bool(x)`` / ``np.asarray(x)`` /
+``x.item()`` on a traced value forces a device->host transfer AND a
+synchronization barrier at every trace -- one stray cast in a vmapped
+kernel serializes the whole dispatch wave (the exact pathology the
+frontier's async dispatch/prefetch pipeline exists to avoid).  Branching
+on a traced value (``if jnp.any(mask):``) is the same sync wearing
+control-flow clothes, plus a ConcretizationTypeError under jit.
+
+The rule fires only inside the jit-region index (engine docstring):
+host code is free to call numpy all it likes.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from explicit_hybrid_mpc_tpu.analysis.engine import (Finding, ModuleContext,
+                                                     Rule, _attr_chain)
+
+#: builtins that concretize a traced value.
+_HOST_CASTS = {"float", "int", "bool"}
+#: numpy entry points that force a transfer when fed a tracer.
+_NP_SYNC = {"asarray", "array", "copy", "ascontiguousarray"}
+_NP_ROOTS = {"np", "numpy", "onp"}
+#: methods that block on / concretize device values.
+_SYNC_METHODS = {"item", "tolist", "block_until_ready"}
+#: jnp/jax reductions whose value a branch test would concretize.
+_ARRAY_ROOTS = {"jnp", "jax", "lax"}
+
+
+class HostSyncInJit(Rule):
+    name = "host-sync-in-jit"
+    severity = "error"
+    doc = ("host transfer/synchronization inside jit-traced code "
+           "(float()/int()/bool()/np.asarray()/.item()/branch on a "
+           "traced value)")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call) and ctx.in_jit(node):
+                yield from self._check_call(ctx, node)
+            elif isinstance(node, (ast.If, ast.While)) and ctx.in_jit(node):
+                yield from self._check_branch(ctx, node)
+
+    def _check_call(self, ctx: ModuleContext, node: ast.Call
+                    ) -> Iterator[Finding]:
+        fn = node.func
+        if isinstance(fn, ast.Name) and fn.id in _HOST_CASTS and node.args:
+            yield self.finding(
+                ctx, node,
+                f"{fn.id}() in a jit-traced region concretizes its "
+                "argument (device sync per trace); keep it an array or "
+                "hoist the cast to host code")
+        elif isinstance(fn, ast.Attribute):
+            chain = _attr_chain(fn)
+            if fn.attr in _SYNC_METHODS:
+                yield self.finding(
+                    ctx, node,
+                    f".{fn.attr}() in a jit-traced region blocks on the "
+                    "device; return the array and read it after the wave")
+            elif (fn.attr in _NP_SYNC and chain
+                  and chain[0] in _NP_ROOTS):
+                yield self.finding(
+                    ctx, node,
+                    f"{'.'.join(chain)}() in a jit-traced region forces "
+                    "a device->host transfer; use jnp (traced) or move "
+                    "the conversion outside the jitted function")
+
+    def _check_branch(self, ctx: ModuleContext, node: ast.AST
+                      ) -> Iterator[Finding]:
+        test = node.test  # type: ignore[attr-defined]
+        for sub in ast.walk(test):
+            if isinstance(sub, ast.Call) \
+                    and isinstance(sub.func, ast.Attribute):
+                chain = _attr_chain(sub.func)
+                if chain and chain[0] in _ARRAY_ROOTS:
+                    kw = "if" if isinstance(node, ast.If) else "while"
+                    yield self.finding(
+                        ctx, node,
+                        f"`{kw}` on a traced value "
+                        f"({'.'.join(chain)}(...)) in a jit region: "
+                        "concretizes per trace (or raises under jit); "
+                        "use jnp.where / lax.cond / a host-side mask "
+                        "read after the wave")
+                    return

@@ -1,0 +1,55 @@
+"""obs-in-hot-loop: observability emission inside jit-traced code.
+
+The obs subsystem's contract (docs/observability.md) is host-side:
+sinks take plain dicts, metric objects mutate Python state under a
+lock.  Called from inside a jit trace they either concretize tracers
+(a host sync per trace) or -- worse -- run once at TRACE time and then
+silently never again, so the counter undercounts by exactly the cache
+hit rate.  Emission belongs in host code around the wave
+(frontier.step's post-consume block) or behind
+``jax.debug.callback`` / ``io_callback`` when it truly must originate
+inside traced code.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from explicit_hybrid_mpc_tpu.analysis.engine import (Finding, ModuleContext,
+                                                     Rule, _attr_chain)
+
+#: method names that are unambiguously obs emission.
+_EMIT_METHODS = {"emit", "event", "observe", "span", "flush_metrics", "inc"}
+#: object-chain segments that mark the receiver as an obs handle.
+_OBS_SEGMENTS = {"obs", "metrics", "sink", "recorder", "tracer"}
+#: roots whose methods share names with the above but are array math
+#: (jnp.log, math.log, ...): never obs receivers.
+_ARRAY_ROOTS = {"np", "numpy", "jnp", "jax", "lax", "math", "scipy"}
+
+
+class ObsInHotLoop(Rule):
+    name = "obs-in-hot-loop"
+    severity = "error"
+    doc = ("sink/metric emission inside jit-traced code -- runs at "
+           "trace time (undercounts) or syncs per trace; use host "
+           "callbacks or post-wave snapshots")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and ctx.in_jit(node)):
+                continue
+            chain = _attr_chain(node.func)
+            if chain and chain[0] in _ARRAY_ROOTS:
+                continue
+            receiver = chain[:-1] if chain else []
+            if node.func.attr in _EMIT_METHODS \
+                    or any(seg in _OBS_SEGMENTS for seg in receiver):
+                yield self.finding(
+                    ctx, node,
+                    f"{'.'.join(chain) or node.func.attr}(...) inside "
+                    "jit-traced code: emission runs at trace time (then "
+                    "never again on cache hits) or forces a host sync; "
+                    "emit from host code after the wave")

@@ -1,0 +1,162 @@
+"""recompile-hazard: patterns that multiply XLA compilations.
+
+Three sub-checks, each a pattern that turns "compile once, dispatch
+thousands of times" into "compile per call":
+
+1. **jit-in-function**: ``jax.jit(...)`` called inside a plain function
+   body builds a FRESH compiled callable (and jit cache) per call --
+   every invocation retraces and recompiles.  Constructors
+   (``__init__`` and friends) are exempt: building a program family
+   once per object is the repo's standard pattern (oracle.Oracle);
+   ``functools.cache``/``lru_cache``-decorated enclosing functions are
+   exempt too (the closure IS the cache).
+2. **loop-varying closure**: a jit-wrapped lambda closing over a local
+   that an enclosing loop rebinds -- each rebinding is a new hashable
+   constant baked into the trace, so the jit cache grows with the loop
+   instead of hitting.
+3. **non-pow-2 bucket literal**: padding/bucket sizes feeding the
+   batched solver paths (``qp_solve`` / ``solve_pairs_full`` and the
+   dispatch plumbing around them) must be powers of two -- that is the
+   repo-wide invariant bounding the compiled-shape set
+   (Oracle.max_points_per_call, sharded._bucket).  An int literal
+   bucket/pad/cap that is not a power of two silently mints a new
+   compiled shape per distinct batch size.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator
+
+from explicit_hybrid_mpc_tpu.analysis.engine import (Finding, ModuleContext,
+                                                     Rule, _attr_chain,
+                                                     _call_name)
+
+_CTOR_NAMES = {"__init__", "__new__", "__post_init__", "__init_subclass__"}
+_CACHE_DECOS = {"cache", "lru_cache", "cached_property"}
+_BUCKET_NAME = re.compile(r"(bucket|pad|batch|chunk|cap)s?$", re.IGNORECASE)
+
+
+def _pow2(n: int) -> bool:
+    return n > 0 and (n & (n - 1)) == 0
+
+
+class RecompileHazard(Rule):
+    name = "recompile-hazard"
+    severity = "warn"
+    doc = ("jit-in-function (fresh compile per call), loop-varying "
+           "closures baked into traces, non-pow-2 bucket literals "
+           "feeding the batched solver paths")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call):
+                name = _call_name(node.func)
+                if name == "jit":
+                    yield from self._check_jit_site(ctx, node)
+                if name in ("jit", "vmap", "shard_map") and node.args \
+                        and isinstance(node.args[0], ast.Lambda):
+                    yield from self._check_closure(ctx, node.args[0])
+            elif isinstance(node, (ast.Assign, ast.AnnAssign)):
+                yield from self._check_bucket_assign(ctx, node)
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.keyword) and node.arg \
+                    and _BUCKET_NAME.search(node.arg):
+                v = node.value
+                if isinstance(v, ast.Constant) and isinstance(v.value, int) \
+                        and not isinstance(v.value, bool) \
+                        and v.value > 2 and not _pow2(v.value):
+                    yield self.finding(
+                        ctx, v,
+                        f"non-power-of-two literal {v.value} for "
+                        f"'{node.arg}': padding buckets must be powers "
+                        "of two to bound the compiled-shape set")
+
+    # -- 1. jit built inside a per-call function ---------------------------
+
+    def _check_jit_site(self, ctx: ModuleContext, node: ast.Call
+                        ) -> Iterator[Finding]:
+        fn = ctx.enclosing_function(node)
+        if fn is None or isinstance(fn, ast.Lambda):
+            return
+        if fn.name in _CTOR_NAMES:
+            return
+        for dec in fn.decorator_list:
+            target = dec.func if isinstance(dec, ast.Call) else dec
+            if _call_name(target) in _CACHE_DECOS:
+                return
+        yield self.finding(
+            ctx, node,
+            f"jax.jit(...) inside `{fn.name}` builds a fresh compiled "
+            "callable (and empty jit cache) per call -- every invocation "
+            "recompiles; hoist to module/constructor scope or "
+            "functools.cache the builder")
+
+    # -- 2. loop-varying closures ------------------------------------------
+
+    def _check_closure(self, ctx: ModuleContext, lam: ast.Lambda
+                       ) -> Iterator[Finding]:
+        params = {a.arg for a in (lam.args.args + lam.args.kwonlyargs
+                                  + lam.args.posonlyargs)}
+        if lam.args.vararg:
+            params.add(lam.args.vararg.arg)
+        free = {n.id for n in ast.walk(lam.body)
+                if isinstance(n, ast.Name)
+                and isinstance(n.ctx, ast.Load)} - params
+        fn = ctx.enclosing_function(lam)
+        if fn is None or not free:
+            return
+        for node in ast.walk(fn):
+            targets: list[ast.AST] = []
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+            elif isinstance(node, ast.AugAssign):
+                targets = [node.target]
+            elif isinstance(node, ast.For):
+                targets = [node.target]
+            for t in targets:
+                for nm in ast.walk(t):
+                    if isinstance(nm, ast.Name) and nm.id in free \
+                            and self._in_loop(ctx, node, stop=fn):
+                        yield self.finding(
+                            ctx, lam,
+                            f"jitted lambda closes over `{nm.id}`, which "
+                            "an enclosing loop rebinds: each value is a "
+                            "new trace constant, so the jit cache grows "
+                            "with the loop; pass it as an argument "
+                            "instead")
+                        return
+
+    @staticmethod
+    def _in_loop(ctx: ModuleContext, node: ast.AST, stop: ast.AST) -> bool:
+        cur = ctx.parents.get(node)
+        while cur is not None and cur is not stop:
+            if isinstance(cur, (ast.For, ast.While)):
+                return True
+            cur = ctx.parents.get(cur)
+        return isinstance(node, ast.For)
+
+    # -- 3. non-pow-2 bucket literals --------------------------------------
+
+    def _check_bucket_assign(self, ctx: ModuleContext, node: ast.AST
+                             ) -> Iterator[Finding]:
+        if isinstance(node, ast.Assign):
+            targets, value = node.targets, node.value
+        else:  # AnnAssign
+            targets = [node.target]  # type: ignore[attr-defined]
+            value = node.value  # type: ignore[attr-defined]
+        if not (isinstance(value, ast.Constant)
+                and isinstance(value.value, int)
+                and not isinstance(value.value, bool)
+                and value.value > 2 and not _pow2(value.value)):
+            return
+        for t in targets:
+            chain = _attr_chain(t)
+            if chain and _BUCKET_NAME.search(chain[-1]):
+                yield self.finding(
+                    ctx, node,
+                    f"non-power-of-two literal {value.value} assigned to "
+                    f"'{chain[-1]}': padding buckets must be powers of "
+                    "two to bound the compiled-shape set")
+                return

@@ -181,6 +181,17 @@ class PartitionConfig:
     # stream.  scripts/obs_watch.py applies the same schema to a live
     # stream from outside the process.
     health_rules: tuple = ()
+    # Runtime recompile sentinel (analysis/recompile_guard.py): once
+    # the build has run a warmup of FULL-size batches (the compiled-
+    # shape set is complete by then -- pow-2 padding bounds it), any
+    # NEW oracle program shape minted during a subsequent full-size
+    # step is an unexpected recompilation.  'warn' emits a
+    # health.recompile event into the obs stream (and the in-build
+    # HealthMonitor's verdict); 'raise' aborts the build (CI mode);
+    # 'off' adds no per-step work.  Ramp-up and drain-down steps
+    # (partial batches) are exempt: small final batches legitimately
+    # mint new pow-2 buckets.
+    recompile_guard: str = "off"
     seed: int = 0
 
     def __post_init__(self) -> None:
@@ -197,6 +208,10 @@ class PartitionConfig:
         if self.ipm_phase1_iters is not None and self.ipm_phase1_iters < 1:
             raise ValueError("ipm_phase1_iters must be >= 1 (or None for "
                              "the automatic 2/5 split)")
+        if self.recompile_guard not in ("off", "warn", "raise"):
+            raise ValueError(f"unknown recompile_guard "
+                             f"{self.recompile_guard!r} (expected 'off', "
+                             "'warn', or 'raise')")
         if self.health_rules:
             # Validate rule names eagerly: a typo'd rule that silently
             # never fires defeats the watchdog's purpose.

@@ -100,6 +100,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "scripts/replay_solve.py")
     p.add_argument("--recorder-dir", metavar="DIR", default=None,
                    help="bundle directory (default PREFIX.repro/)")
+    p.add_argument("--recompile-guard", choices=("warn", "raise"),
+                   default=None,
+                   help="runtime recompile sentinel "
+                        "(analysis/recompile_guard.py): after the "
+                        "steady-state warmup, any NEW compiled oracle "
+                        "shape on a full-size frontier step emits a "
+                        "health.recompile event (warn) or aborts the "
+                        "build (raise)")
     p.add_argument("--health-rule", action="append", default=[],
                    metavar="NAME=VALUE",
                    help="override a streaming health rule (repeatable; "
@@ -218,7 +226,8 @@ def main(argv: list[str] | None = None) -> int:
         obs_recorder=args.recorder or bool(args.recorder_dir),
         recorder_dir=(args.recorder_dir or f"{prefix}.repro"
                       if args.recorder or args.recorder_dir else None),
-        health_rules=_parse_health_rules(args.health_rule))
+        health_rules=_parse_health_rules(args.health_rule),
+        recompile_guard=args.recompile_guard or "off")
 
     if snapshot is not None:
         # SOLVER flags (precision/backend/eps/batch...) come from the
@@ -283,7 +292,8 @@ def main(argv: list[str] | None = None) -> int:
             # solve, so THIS run's flags win over the snapshot's.
             obs_recorder=cfg.obs_recorder,
             recorder_dir=cfg.recorder_dir,
-            health_rules=cfg.health_rules)
+            health_rules=cfg.health_rules,
+            recompile_guard=cfg.recompile_guard)
 
     # Built from the FINAL cfg: on resume that is the snapshot's problem +
     # constructor args, so matrix shapes always match the restored cache.

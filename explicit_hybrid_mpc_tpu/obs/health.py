@@ -46,6 +46,13 @@ Overrides travel as ``(name, value)`` pairs (``cfg.health_rules``, the
 ``--health-rule`` CLI flag, ``LONG_HEALTH_RULES``); unknown names raise
 -- a typo'd rule silently never firing is the failure mode this module
 exists to prevent.
+
+Besides its own rules, the monitor ADOPTS ``health.*`` event records
+already in the flow it is fed -- the frontier's runtime recompile
+sentinel emits ``health.recompile`` (analysis/recompile_guard.py,
+docs/static_analysis.md), and a tailed stream may carry another
+monitor's findings -- folding their severity into ``worst`` so
+obs_watch's exit code and long_build's halt decision see them.
 """
 
 from __future__ import annotations
@@ -155,6 +162,23 @@ class HealthMonitor:
             self._feed_step(rec)
         elif kind == "metrics":
             self._feed_metrics(rec)
+        elif kind == "event" and isinstance(name, str) \
+                and name.startswith("health.") \
+                and rec.get("severity") in _SEVERITY:
+            # A health verdict ALREADY IN the record flow -- the
+            # frontier's recompile sentinel (health.recompile), or a
+            # prior monitor's events when tailing a stream: adopt it.
+            # Without this fold, an external tailer (obs_watch) would
+            # read a stream full of in-build findings and still exit 0,
+            # and the in-build monitor would never see guard events.
+            sev = rec["severity"]
+            if _SEVERITY[sev] > _SEVERITY[self.worst]:
+                self.worst = sev
+            self.events.append({
+                "name": name, "severity": sev,
+                "value": rec.get("value"),
+                "threshold": rec.get("threshold"),
+                "msg": rec.get("msg", "(external health event)")})
         elif kind == "event" and (name == "build.device_failure"
                                   or (name == "runlog"
                                       and "device_failure" in rec)):

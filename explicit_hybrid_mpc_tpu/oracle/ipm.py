@@ -33,6 +33,7 @@ KKT with slacks s >= 0, multipliers lam >= 0:
 
 from __future__ import annotations
 
+import functools
 from typing import NamedTuple
 
 import jax
@@ -292,13 +293,24 @@ def solve_mask(Q, q, A, b, n_iter: int = 30, n_f32: int = 0,
     """
     import numpy as np
 
-    fn = jax.jit(jax.vmap(
-        lambda Qk, qk, Ak, bk: qp_solve(Qk, qk, Ak, bk, n_iter=n_iter,
-                                        tol=tol, n_f32=n_f32)))
-    sol = fn(jnp.asarray(Q), jnp.asarray(q), jnp.asarray(A),
-             jnp.asarray(b))
+    sol = _mask_solver(int(n_iter), int(n_f32), float(tol))(
+        jnp.asarray(Q), jnp.asarray(q), jnp.asarray(A), jnp.asarray(b))
     return (np.asarray(sol.converged), np.asarray(sol.feasible),
             np.asarray(sol.rp))
+
+
+@functools.lru_cache(maxsize=32)
+def _mask_solver(n_iter: int, n_f32: int, tol: float):
+    """Jitted batch solver behind solve_mask, cached per schedule.
+
+    Building the jax.jit wrapper inside solve_mask itself minted a
+    fresh compiled callable -- and an empty jit cache -- per CALL, so
+    every replay probe recompiled the whole vmapped kernel (found by
+    tpulint's recompile-hazard rule).  The cache key is the schedule;
+    jit's own cache handles the batch shapes."""
+    return jax.jit(jax.vmap(
+        lambda Qk, qk, Ak, bk: qp_solve(Qk, qk, Ak, bk, n_iter=n_iter,
+                                        tol=tol, n_f32=n_f32)))
 
 
 def phase1(A: jax.Array, b: jax.Array, n_iter: int = 30,

@@ -823,7 +823,10 @@ class Oracle:
                       "backend": self.backend,
                       "n_anomalous": int(bad.sum()),
                       "captured": int(idx.size)})
-        except Exception:  # full disk, bad perms: anomaly stays counted
+        # tpulint: disable on the guard below -- full disk / bad perms:
+        # the anomaly stays counted; a repro dump must never break the
+        # solve it observes.
+        except Exception:  # tpulint: disable=silent-except -- diag guard
             pass
 
     def _capture_simplex(self, Ms: np.ndarray, ds: np.ndarray,
@@ -855,7 +858,9 @@ class Oracle:
                       "backend": self.backend,
                       "n_anomalous": int(bad.sum()),
                       "captured": int(idx.size)})
-        except Exception:
+        # tpulint: justification -- same contract as _capture_pairs: a
+        # failed repro dump must never break the solve it observes.
+        except Exception:  # tpulint: disable=silent-except -- diag guard
             pass
 
     @staticmethod

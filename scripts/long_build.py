@@ -33,8 +33,14 @@ every checkpoint's metrics snapshot and the campaign CHECKPOINT-AND-
 HALTS on a critical verdict, stop_reason="health_halt", instead of
 burning the rest of a TPU allocation on a sick build), and
 LONG_HEALTH_RULES (JSON dict of obs.health.DEFAULT_RULES overrides).
-An external terminal can additionally follow the live stream:
-``python scripts/obs_watch.py <artifact>.obs.jsonl``.
+LONG_RECOMPILE_GUARD (default ``warn``; ``0``/``off`` disables,
+``raise`` aborts): the runtime recompile sentinel
+(analysis/recompile_guard.py) -- a NEW compiled oracle shape minted
+during the steady-state wave loop emits a ``health.recompile`` event
+into the obs stream, where the in-build HealthMonitor folds it into
+the campaign verdict and an external ``scripts/obs_watch.py`` tail
+exits nonzero on it.  An external terminal can additionally follow the
+live stream: ``python scripts/obs_watch.py <artifact>.obs.jsonl``.
 """
 
 from __future__ import annotations
@@ -58,6 +64,12 @@ SENTINEL = os.path.join(ART, ".capture_active")
 def write_out(path: str, result: dict) -> None:
     with open(path, "w") as f:
         json.dump(result, f, indent=2)
+
+
+def _rc_guard_mode(env: str) -> str:
+    """LONG_RECOMPILE_GUARD value -> cfg.recompile_guard ('0'/'1'
+    boolean shorthands map to off/warn like the other LONG_ knobs)."""
+    return {"0": "off", "1": "warn"}.get(env, env)
 
 
 def run(result: dict, out_path: str) -> None:
@@ -121,6 +133,13 @@ def run(result: dict, out_path: str) -> None:
                                    "repro")
                       if os.environ.get("LONG_RECORDER", "1") != "0"
                       else None),
+        # Recompile sentinel, warn-only by default: a multi-hour
+        # campaign that silently re-lowers its steady-state programs is
+        # burning emulated-f64 compile time per wave; the health.
+        # recompile events make that visible to the watchdog instead of
+        # only to a post-hoc profile.
+        recompile_guard=_rc_guard_mode(
+            os.environ.get("LONG_RECOMPILE_GUARD", "warn")),
         log_path=out_path.replace(".json", ".log.jsonl"))
     okw = dict(backend="device" if platform != "cpu" else "cpu",
                precision=precision, **sched_kw)

@@ -17,11 +17,17 @@ prints:
   flagging >tol regressions in regions/sec and histogram p99s against
   the bench's own `metrics` block, plus iteration-economy regressions
   (lower wasted_iter_frac / warmstart_accept_rate than the bench
-  recorded) so extra arithmetic per region is flagged like latency.
+  recorded) so extra arithmetic per region is flagged like latency;
+- with ``--drift PREV.obs.jsonl``: the ``oracle.compiled_shapes``
+  ledger of this stream vs an earlier one -- GROWTH at comparable
+  scale is a recompile regression (new program shapes minted per run;
+  the static/runtime side of the same invariant lives in
+  scripts/tpulint.py and analysis/recompile_guard.py, see
+  docs/static_analysis.md) and is flagged like a latency regression.
 
 Usage:
     python scripts/obs_report.py RUN.obs.jsonl [--bench BENCH.json]
-        [--json OUT.json] [--tol 0.10]
+        [--drift PREV.obs.jsonl] [--json OUT.json] [--tol 0.10]
 """
 
 from __future__ import annotations
@@ -226,6 +232,30 @@ def diff_bench(rep: dict, bench: dict, tol: float = 0.10) -> list[str]:
     return flags
 
 
+def diff_drift(rep: dict, prev: dict) -> tuple[list[str], dict]:
+    """Compiled-shape drift between two streams' reports: (flags,
+    summary).  Growth is directional -- a run that compiled FEWER
+    shapes is not a regression; a run that compiled more minted new
+    device programs for the same workload (shape churn, the recompile
+    pathology tpulint's rules gate statically)."""
+    cur = rep.get("gauges", {}).get("oracle.compiled_shapes")
+    old = prev.get("gauges", {}).get("oracle.compiled_shapes")
+    summary = {"compiled_shapes": cur, "prev_compiled_shapes": old}
+    flags: list[str] = []
+    if cur is None or old is None:
+        summary["note"] = ("one or both streams carry no "
+                           "oracle.compiled_shapes gauge (obs off or "
+                           "pre-PR-3 stream)")
+        return flags, summary
+    if cur > old:
+        flags.append(
+            f"compiled-shape growth: {int(cur)} shapes vs {int(old)} in "
+            f"the earlier stream (+{int(cur - old)}): the same workload "
+            "minted new device programs -- a recompile regression "
+            "(docs/static_analysis.md)")
+    return flags, summary
+
+
 def _fmt_lat(v: float | None) -> str:
     if v is None:
         return "-"
@@ -295,13 +325,17 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--bench", default=None,
                     help="BENCH_*.json to diff against "
                          "(default: newest in the repo root)")
+    ap.add_argument("--drift", metavar="PREV", default=None,
+                    help="earlier obs JSONL stream: flag "
+                         "oracle.compiled_shapes growth vs it as a "
+                         "recompile regression")
     ap.add_argument("--json", dest="json_out", default=None,
                     help="also write the structured report here")
     ap.add_argument("--tol", type=float, default=0.10,
                     help="relative regression tolerance (default 0.10)")
     ap.add_argument("--strict", action="store_true",
-                    help="exit nonzero when any bench-diff flag fires "
-                         "(CI mode)")
+                    help="exit nonzero when any bench-diff or drift "
+                         "flag fires (CI mode)")
     args = ap.parse_args(argv)
 
     rep = report(load_jsonl(args.stream))
@@ -316,12 +350,40 @@ def main(argv: list[str] | None = None) -> int:
             del rep["warnings"]
     else:
         bench_path = None
+    drift_summary = None
+    drift_flags: list[str] = []
+    if args.drift:
+        if os.path.exists(args.drift):
+            prev = report(load_jsonl(args.drift))
+            drift_flags, drift_summary = diff_drift(rep, prev)
+        else:
+            # Degrade like a missing --bench: a rotated-away artifact
+            # must not exit with the same code as a real regression.
+            drift_summary = {"note": f"previous stream {args.drift} "
+                                     "not found; drift not computed"}
     print(render_text(rep, flags, bench_path))
+    if drift_summary is not None:
+        if "compiled_shapes" in drift_summary:
+            print(f"compiled-shape drift vs "
+                  f"{os.path.basename(args.drift)}: "
+                  f"{drift_summary.get('compiled_shapes')} vs "
+                  f"{drift_summary.get('prev_compiled_shapes')}"
+                  + (f" ({drift_summary['note']})"
+                     if "note" in drift_summary else ""))
+        else:
+            print(f"compiled-shape drift: {drift_summary['note']}")
+        for fl in drift_flags:
+            print(f"  REGRESSION: {fl}")
     if args.json_out:
+        # Flags keep their provenance: machine consumers must not
+        # attribute a compiled-shape drift regression to a bench
+        # comparison that may never have run.
         with open(args.json_out, "w") as f:
             json.dump({"report": rep, "bench": bench_path,
-                       "bench_flags": flags}, f, indent=2)
-    return 1 if (args.strict and flags) else 0
+                       "bench_flags": flags,
+                       "drift_flags": drift_flags,
+                       "drift": drift_summary}, f, indent=2)
+    return 1 if (args.strict and (flags or drift_flags)) else 0
 
 
 if __name__ == "__main__":

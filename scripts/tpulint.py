@@ -1,0 +1,142 @@
+"""tpulint CLI: the pre-merge TPU-hostility gate (docs/static_analysis.md).
+
+Lints the package (default: ``explicit_hybrid_mpc_tpu/``) with the
+analysis/rules pack -- host-sync-in-jit, recompile-hazard,
+dtype-discipline, obs-in-hot-loop, silent-except -- and exits nonzero
+on any finding not covered by the checked-in ``TPULINT_BASELINE.json``
+or an inline ``# tpulint: disable=<rule>`` pragma.
+
+This is a pre-merge check alongside scripts/bench_gate.py
+(docs/perf.md): the bench gate catches throughput regressions AFTER
+they happen; this gate catches the code patterns that cause the worst
+of them (hidden host syncs, shape churn) BEFORE a TPU allocation is
+burned measuring the damage.
+
+Usage:
+    python scripts/tpulint.py                       # gate the package
+    python scripts/tpulint.py path/ other.py        # explicit targets
+    python scripts/tpulint.py --json report.json    # machine output
+    python scripts/tpulint.py --rules silent-except,dtype-discipline
+    python scripts/tpulint.py --update-baseline     # absorb findings
+    python scripts/tpulint.py --no-baseline         # gate EVERYTHING
+
+Exit codes: 0 clean (or fully baselined/suppressed), 1 new findings,
+2 usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from explicit_hybrid_mpc_tpu.analysis import engine  # noqa: E402
+from explicit_hybrid_mpc_tpu.analysis.rules import (  # noqa: E402
+    all_rules, rules_by_name)
+
+DEFAULT_BASELINE = os.path.join(REPO, "TPULINT_BASELINE.json")
+DEFAULT_TARGET = os.path.join(REPO, "explicit_hybrid_mpc_tpu")
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("paths", nargs="*",
+                    help="files/directories to lint "
+                         "(default: the package)")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="baseline JSON (default: repo "
+                         "TPULINT_BASELINE.json)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline: every finding gates")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite the baseline from the current "
+                         "findings and exit 0")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated rule ids to run "
+                         "(default: all)")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalog and exit")
+    ap.add_argument("--json", dest="json_out", default=None,
+                    help="also write findings as JSON here "
+                         "('-' = stdout)")
+    ap.add_argument("-q", "--quiet", action="store_true",
+                    help="summary line only")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for r in all_rules():
+            print(f"{r.name:20s} [{r.severity}] {r.doc}")
+        return 0
+
+    rules = all_rules()
+    if args.rules:
+        known = rules_by_name()
+        picked = []
+        for name in args.rules.split(","):
+            name = name.strip()
+            if name not in known:
+                print(f"tpulint: unknown rule {name!r} (known: "
+                      f"{', '.join(sorted(known))})", file=sys.stderr)
+                return 2
+            picked.append(known[name])
+        rules = picked
+
+    paths = args.paths or [DEFAULT_TARGET]
+    findings = engine.lint_paths(paths, rules, root=REPO)
+
+    if args.update_baseline:
+        # The repo baseline covers the WHOLE package under ALL rules:
+        # rewriting it from a restricted run (explicit paths or
+        # --rules) would silently drop every other file's/rule's
+        # baselined entries and fail the next full gate.  Scoped
+        # updates are fine against an explicit --baseline file (the
+        # fixture workflow).
+        if (args.paths or args.rules) and os.path.abspath(
+                args.baseline) == os.path.abspath(DEFAULT_BASELINE):
+            print("tpulint: refusing to rewrite the repo baseline from "
+                  "a restricted run (explicit paths / --rules would "
+                  "drop every other baselined entry); run without "
+                  "targets or pass --baseline FILE", file=sys.stderr)
+            return 2
+        with open(args.baseline, "w", encoding="utf-8") as fh:
+            json.dump(engine.baseline_payload(findings), fh, indent=2,
+                      sort_keys=True)
+            fh.write("\n")
+        print(f"tpulint: baseline updated: {len(findings)} finding(s) "
+              f"-> {os.path.relpath(args.baseline, REPO)}")
+        return 0
+
+    baseline = (engine.load_baseline(args.baseline)
+                if not args.no_baseline else collections.Counter())
+    new, baselined = engine.split_baselined(findings, baseline)
+
+    if not args.quiet:
+        for f in new:
+            print(f.render())
+        if baselined:
+            print(f"tpulint: {len(baselined)} baselined finding(s) "
+                  "suppressed (see --no-baseline)")
+    n_err = sum(1 for f in new if f.severity == "error")
+    print(f"tpulint: {len(new)} new finding(s) "
+          f"({n_err} error, {len(new) - n_err} warn), "
+          f"{len(baselined)} baselined, "
+          f"{len(paths)} target(s)")
+    if args.json_out:
+        payload = {"findings": [f.to_dict() for f in new],
+                   "baselined": [f.to_dict() for f in baselined]}
+        if args.json_out == "-":
+            json.dump(payload, sys.stdout, indent=2)
+            print()
+        else:
+            with open(args.json_out, "w", encoding="utf-8") as fh:
+                json.dump(payload, fh, indent=2)
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -413,6 +413,36 @@ def test_obs_report_surfaces_health_events_and_bundles(tmp_path, capsys):
     assert "2 repro bundle(s)" in out
 
 
+def test_obs_report_drift_flags_compiled_shape_growth(tmp_path, capsys):
+    """--drift (ISSUE 6): oracle.compiled_shapes growth between two
+    streams is a recompile regression; shrinkage is not."""
+    from explicit_hybrid_mpc_tpu.obs.sink import JsonlSink
+
+    obs_report = _script("obs_report")
+
+    def stream(name, shapes):
+        path = str(tmp_path / name)
+        with JsonlSink(path, schema_meta=True) as s:
+            s.emit("metrics", "snapshot", counters={}, histograms={},
+                   gauges={"oracle.compiled_shapes": float(shapes)})
+        return path
+
+    old = stream("old.obs.jsonl", 40)
+    grown = stream("grown.obs.jsonl", 52)
+    no_bench = ["--bench", str(tmp_path / "missing.json")]
+    rc = obs_report.main([grown, "--drift", old] + no_bench)
+    out = capsys.readouterr().out
+    assert rc == 0  # advisory without --strict
+    assert "compiled-shape growth" in out and "52" in out
+    rc = obs_report.main([grown, "--drift", old, "--strict"] + no_bench)
+    capsys.readouterr()
+    assert rc == 1
+    # Fewer shapes than before: directional, not a regression.
+    rc = obs_report.main([old, "--drift", grown, "--strict"] + no_bench)
+    out = capsys.readouterr().out
+    assert rc == 0 and "compiled-shape drift" in out
+
+
 # -- bench regression gate -------------------------------------------------
 
 def _bench(value, platform="cpu", **kw):

@@ -1,0 +1,189 @@
+"""Runtime recompile sentinel (analysis/recompile_guard.py, ISSUE 6).
+
+The acceptance case: the guard catches a deliberately shape-unstable
+jit call.  Plus: ledger-based (oracle.compiled_shapes) detection, warn
+mode's health.recompile event into the obs stream, the HealthMonitor
+adopting external health events, the frontier's steady-state wiring,
+and a healthy end-to-end build emitting ZERO recompile events.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from explicit_hybrid_mpc_tpu import obs as obs_lib
+from explicit_hybrid_mpc_tpu.analysis.recompile_guard import (
+    RecompileError, RecompileGuard)
+from explicit_hybrid_mpc_tpu.config import PartitionConfig
+from explicit_hybrid_mpc_tpu.obs.health import HealthMonitor
+from explicit_hybrid_mpc_tpu.partition.frontier import build_partition
+from explicit_hybrid_mpc_tpu.problems.registry import make
+
+
+class _LedgerOracle:
+    """Duck-typed stand-in for Oracle's compiled-shape ledger."""
+
+    def __init__(self):
+        self.compiled_shapes = {("grid", 8), ("pairs", 16)}
+
+
+def test_guard_catches_shape_unstable_jit_call():
+    fn = jax.jit(lambda x: x * 2.0)
+    with pytest.raises(RecompileError, match="jit-cache"):
+        with RecompileGuard(watch=[fn], action="raise"):
+            fn(jnp.zeros(4))   # first lowering INSIDE the guarded phase
+            fn(jnp.zeros(16))  # second shape: the violation
+
+
+def test_guard_passes_shape_stable_jit_call():
+    fn = jax.jit(lambda x: x * 2.0)
+    fn(jnp.zeros(4))  # compile before the guarded phase
+    with RecompileGuard(watch=[fn], action="raise"):
+        for _ in range(3):
+            fn(jnp.ones(4))  # same shape: cache hits only
+
+
+def test_guard_ledger_warn_mode_emits_and_rearms():
+    o = _LedgerOracle()
+    g = RecompileGuard(oracle=o, action="warn", label="t")
+    assert g.check() is None
+    o.compiled_shapes.add(("grid", 32))
+    ev = g.check(step=7)
+    assert ev["name"] == "health.recompile" and ev["severity"] == "warn"
+    assert ev["step"] == 7 and "grid[32]" in ev["msg"]
+    # Re-armed: the same ledger state does not re-fire.
+    assert g.check() is None
+    assert g.n_violations == 1
+
+
+def test_guard_event_lands_in_obs_stream(tmp_path):
+    path = str(tmp_path / "s.obs.jsonl")
+    with obs_lib.Obs("jsonl", path=path) as o:
+        lo = _LedgerOracle()
+        g = RecompileGuard(oracle=lo, obs=o, action="warn")
+        lo.compiled_shapes.add(("grid", 64))
+        g.check()
+    recs = obs_lib.load_jsonl(path)
+    evs = [r for r in recs if r.get("name") == "health.recompile"]
+    assert len(evs) == 1 and evs[0]["severity"] == "warn"
+
+
+def test_guard_exit_never_masks_inflight_exception():
+    fn = jax.jit(lambda x: x * 2.0)
+    with pytest.raises(KeyError):
+        with RecompileGuard(watch=[fn], action="raise"):
+            fn(jnp.zeros(4))
+            fn(jnp.zeros(8))  # would raise at exit...
+            raise KeyError("boom")  # ...but the real error wins
+
+
+def test_guard_rejects_unusable_probes():
+    with pytest.raises(ValueError, match="oracle"):
+        RecompileGuard()
+    with pytest.raises(ValueError, match="compiled_shapes"):
+        RecompileGuard(oracle=object())
+    with pytest.raises(ValueError, match="_cache_size"):
+        RecompileGuard(watch=[lambda x: x])
+
+
+def test_health_monitor_adopts_external_health_events():
+    mon = HealthMonitor()
+    evs = mon.feed({"kind": "event", "name": "health.recompile",
+                    "severity": "warn", "value": 1, "msg": "new shape"})
+    assert mon.worst == "warn" and mon.exit_code == 1
+    assert evs and evs[0]["name"] == "health.recompile"
+    assert any(e["name"] == "health.recompile" for e in mon.events)
+    mon.feed({"kind": "event", "name": "health.stall",
+              "severity": "critical", "msg": "frozen"})
+    assert mon.worst == "critical" and mon.exit_code == 2
+
+
+def test_config_validates_guard_mode():
+    with pytest.raises(ValueError, match="recompile_guard"):
+        PartitionConfig(eps_a=0.2, recompile_guard="loud")
+    cfg = PartitionConfig(eps_a=0.2, recompile_guard="warn")
+    assert cfg.recompile_guard == "warn"
+
+
+def test_frontier_guard_fires_on_synthetic_ledger_growth(tmp_path):
+    """End-to-end wiring: a small build with the guard in warn mode is
+    CLEAN, and a synthetic post-warmup ledger insertion produces the
+    health.recompile event via the engine's own hook."""
+    from explicit_hybrid_mpc_tpu.partition.frontier import FrontierEngine
+
+    prob = make("double_integrator", N=3, theta_box=1.5)
+    path = str(tmp_path / "b.obs.jsonl")
+    cfg = PartitionConfig(eps_a=0.2, backend="cpu", batch_simplices=16,
+                          obs="jsonl", obs_path=path,
+                          recompile_guard="warn")
+    from explicit_hybrid_mpc_tpu.partition.frontier import make_oracle
+
+    with obs_lib.Obs("jsonl", path=path) as o:
+        oracle = make_oracle(prob, cfg)
+        eng = FrontierEngine(prob, oracle, cfg, obs=o)
+        while eng.frontier and eng.steps < 200:
+            eng.step()
+        assert eng.tree.n_regions() > 100
+        assert eng._rc_guard is not None
+        # The build itself must be recompile-clean...
+        assert eng._rc_guard.n_violations == 0
+        # ...and a shape minted after warmup is caught by the same hook
+        # the step loop calls (forced full-batch path).
+        eng._rc_steady_steps = eng._GUARD_WARMUP_FULL_STEPS + 1
+        eng.oracle.compiled_shapes.add(("synthetic", 12345))
+        eng._guard_step(cfg.batch_simplices)
+        assert eng._rc_guard.n_violations == 1
+    recs = obs_lib.load_jsonl(path)
+    evs = [r for r in recs if r.get("name") == "health.recompile"]
+    assert len(evs) == 1 and "synthetic" in evs[0]["msg"]
+
+
+def test_frontier_guard_absolves_partial_batch_shapes():
+    """A backlog dip's partial wave legitimately mints a small bucket;
+    the next FULL-size step must not inherit it as a violation (the
+    partial branch re-arms an armed guard)."""
+    prob = make("double_integrator", N=3, theta_box=1.5)
+    cfg = PartitionConfig(eps_a=0.2, backend="cpu", batch_simplices=16,
+                          recompile_guard="raise")
+    from explicit_hybrid_mpc_tpu.partition.frontier import (FrontierEngine,
+                                                            make_oracle)
+
+    eng = FrontierEngine(prob, make_oracle(prob, cfg), cfg)
+    eng._rc_steady_steps = eng._GUARD_WARMUP_FULL_STEPS + 1
+    eng.oracle.compiled_shapes.add(("partial_wave", 4))
+    eng._guard_step(cfg.batch_simplices - 1)  # partial: exempt + re-arm
+    eng._guard_step(cfg.batch_simplices)      # full: must NOT raise
+    assert eng._rc_guard.n_violations == 0
+    # A FULL step's own mint is still caught by its own end-of-step
+    # check, partial re-arms notwithstanding.
+    eng.oracle.compiled_shapes.add(("full_wave", 8))
+    with pytest.raises(RecompileError):
+        eng._guard_step(cfg.batch_simplices)
+
+
+def test_frontier_guard_raise_mode_aborts():
+    prob = make("double_integrator", N=3, theta_box=1.5)
+    cfg = PartitionConfig(eps_a=0.2, backend="cpu", batch_simplices=16,
+                          recompile_guard="raise")
+    from explicit_hybrid_mpc_tpu.partition.frontier import (FrontierEngine,
+                                                            make_oracle)
+
+    eng = FrontierEngine(prob, make_oracle(prob, cfg), cfg)
+    eng._rc_steady_steps = eng._GUARD_WARMUP_FULL_STEPS + 1
+    eng.oracle.compiled_shapes.add(("synthetic", 999))
+    with pytest.raises(RecompileError):
+        eng._guard_step(cfg.batch_simplices)
+
+
+def test_healthy_build_with_guard_emits_no_events(tmp_path):
+    path = str(tmp_path / "clean.obs.jsonl")
+    prob = make("double_integrator", N=3, theta_box=1.5)
+    cfg = PartitionConfig(eps_a=0.2, backend="cpu", batch_simplices=32,
+                          obs="jsonl", obs_path=path,
+                          recompile_guard="warn")
+    res = build_partition(prob, cfg)
+    assert res.stats["uncertified"] == 0
+    recs = obs_lib.load_jsonl(path)
+    assert not [r for r in recs
+                if str(r.get("name", "")).startswith("health.")]

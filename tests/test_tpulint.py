@@ -1,0 +1,429 @@
+"""tpulint rule engine + rule pack + CLI gate (analysis/, ISSUE 6).
+
+Fixture snippets per rule (positive, negative, pragma-suppressed),
+baseline round-trip, the jit-region index's reachability cases, and the
+tier-1 gate itself: the whole package must lint clean against the
+committed TPULINT_BASELINE.json -- the same check scripts/tpulint.py
+runs pre-merge (docs/static_analysis.md)."""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from explicit_hybrid_mpc_tpu.analysis import engine
+from explicit_hybrid_mpc_tpu.analysis.rules import all_rules, rules_by_name
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PACKAGE = os.path.join(REPO, "explicit_hybrid_mpc_tpu")
+BASELINE = os.path.join(REPO, "TPULINT_BASELINE.json")
+
+
+def lint(src: str, rules=None) -> list:
+    return engine.lint_source(textwrap.dedent(src), "fixture.py",
+                              rules=rules, rel="fixture.py")
+
+
+def rule_ids(findings) -> set:
+    return {f.rule for f in findings}
+
+
+# -- host-sync-in-jit ------------------------------------------------------
+
+_HOST_SYNC_POS = """
+    import jax, numpy as np
+
+    @jax.jit
+    def kernel(x):
+        s = float(x.sum())          # host cast
+        a = np.asarray(x)           # np transfer
+        v = x.item()                # blocking read
+        if jnp.any(x > 0):          # traced branch
+            s = s + 1
+        return s + a.sum() + v
+"""
+
+
+def test_host_sync_positive():
+    found = lint(_HOST_SYNC_POS)
+    msgs = [f for f in found if f.rule == "host-sync-in-jit"]
+    assert len(msgs) == 4, found
+    assert all(f.severity == "error" for f in msgs)
+
+
+def test_host_sync_negative_host_code_free():
+    # The SAME calls outside any jit region are plain numpy: clean.
+    found = lint("""
+        import numpy as np
+
+        def host(x):
+            if np.any(x > 0):
+                return float(x.sum()) + np.asarray(x).item()
+            return 0.0
+    """)
+    assert "host-sync-in-jit" not in rule_ids(found)
+
+
+def test_host_sync_negative_static_python_in_jit():
+    # Static Python control flow inside a jitted fn is fine (the
+    # kernels' n_f32 > 0 / warm_start is None patterns).
+    found = lint("""
+        import jax
+
+        @jax.jit
+        def kernel(x, n=3):
+            if n > 0:
+                x = x * n
+            return x
+    """)
+    assert "host-sync-in-jit" not in rule_ids(found)
+
+
+def test_host_sync_pragma_line():
+    found = lint("""
+        import jax
+
+        @jax.jit
+        def kernel(x):
+            return float(x)  # tpulint: disable=host-sync-in-jit -- probe
+    """)
+    assert "host-sync-in-jit" not in rule_ids(found)
+
+
+def test_host_sync_transitive_helper():
+    # A helper CALLED from a jitted lambda is traced too.
+    found = lint("""
+        import jax
+
+        def helper(x):
+            return float(x)
+
+        solve = jax.jit(lambda x: helper(x))
+    """)
+    assert "host-sync-in-jit" in rule_ids(found)
+
+
+def test_jit_index_partial_and_fori_loop():
+    # @functools.partial(jax.jit, ...) decoration and lax.fori_loop
+    # body position both mark their functions.
+    found = lint("""
+        import functools, jax
+
+        @functools.partial(jax.jit, static_argnums=0)
+        def kernel(n, x):
+            return float(x)
+
+        def body(i, c):
+            return c + float(i)
+
+        def run(x):
+            return jax.lax.fori_loop(0, 3, body, x)
+    """)
+    per_line = {f.line for f in found if f.rule == "host-sync-in-jit"}
+    assert len(per_line) == 2, found
+
+
+# -- recompile-hazard ------------------------------------------------------
+
+def test_recompile_jit_in_function_positive_and_ctor_exempt():
+    found = lint("""
+        import jax
+
+        def per_call(x):
+            fn = jax.jit(lambda y: y * 2)   # fresh compile per call
+            return fn(x)
+
+        class Oracle:
+            def __init__(self):
+                self._fn = jax.jit(lambda y: y * 2)  # once per object
+    """)
+    hits = [f for f in found if f.rule == "recompile-hazard"]
+    assert len(hits) == 1 and hits[0].line == 5, found
+
+
+def test_recompile_cached_builder_exempt():
+    found = lint("""
+        import functools, jax
+
+        @functools.lru_cache(maxsize=8)
+        def solver(n):
+            return jax.jit(lambda y: y * n)
+    """)
+    assert "recompile-hazard" not in rule_ids(found)
+
+
+def test_recompile_loop_closure_positive():
+    found = lint("""
+        import jax
+
+        def sweep(xs):
+            out = []
+            for scale in xs:
+                fn = jax.jit(lambda y: y * scale)  # retrace per scale
+                out.append(fn(scale))
+            return out
+    """)
+    hits = [f for f in found if f.rule == "recompile-hazard"
+            and "closes over" in f.msg]
+    assert hits, found
+
+
+def test_recompile_bucket_literal():
+    found = lint("""
+        def plan():
+            pad = 100            # non-pow-2 bucket
+            good_pad = 128       # pow-2: fine
+            return pad + good_pad
+    """)
+    hits = [f for f in found if f.rule == "recompile-hazard"]
+    assert len(hits) == 1 and "100" in hits[0].msg, found
+
+
+def test_recompile_bucket_keyword():
+    found = lint("""
+        def run(solve):
+            return solve(points_cap=1000)
+    """)
+    assert "recompile-hazard" in rule_ids(found)
+    assert "recompile-hazard" not in rule_ids(lint("""
+        def run(solve):
+            return solve(points_cap=1024)
+    """))
+
+
+# -- dtype-discipline ------------------------------------------------------
+
+def test_dtype_builtin_casts():
+    found = lint("""
+        import numpy as np
+
+        def f(x):
+            a = x.astype(float)            # width-ambiguous
+            b = np.zeros(3, dtype=int)     # width-ambiguous
+            c = np.zeros(3, dtype=bool)    # bool: exempt
+            d = x.astype(np.float64)       # named: fine
+            return a, b, c, d
+    """)
+    hits = [f for f in found if f.rule == "dtype-discipline"]
+    assert len(hits) == 2, found
+
+
+def test_dtype_x32_module_tag():
+    tagged = """
+        # tpulint: x32-module
+        import jax.numpy as jnp
+        import numpy as np
+
+        def kernel(x):
+            return x * np.float64(2.0)
+    """
+    found = lint(tagged)
+    assert "dtype-discipline" in rule_ids(found)
+    # Same code without the tag: f64 literals are policy here.
+    untagged = "\n".join(l for l in textwrap.dedent(tagged).splitlines()
+                         if "x32-module" not in l)
+    assert "dtype-discipline" not in rule_ids(
+        engine.lint_source(untagged, "fixture.py", rel="fixture.py"))
+
+
+# -- obs-in-hot-loop -------------------------------------------------------
+
+def test_obs_in_hot_loop_positive_negative():
+    found = lint("""
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def kernel(x, obs):
+            obs.event("bad", v=1)          # emission in trace
+            y = jnp.log(x)                 # array math: fine
+            return y.at[0].set(0.0)        # .set is jnp, not a gauge
+    """)
+    hits = [f for f in found if f.rule == "obs-in-hot-loop"]
+    assert len(hits) == 1 and hits[0].line == 7, found
+
+
+def test_obs_emission_on_host_is_fine():
+    found = lint("""
+        def step(self):
+            self.obs.event("build.step", n=1)
+            self.log.emit(step=1)
+    """)
+    assert "obs-in-hot-loop" not in rule_ids(found)
+
+
+# -- silent-except ---------------------------------------------------------
+
+def test_silent_except_positive_negative_pragma():
+    found = lint("""
+        def risky(solve, x):
+            try:
+                return solve(x)
+            except Exception:
+                pass
+    """)
+    assert "silent-except" in rule_ids(found)
+    # Typed + handled: clean.
+    found = lint("""
+        def risky(solve, x, log):
+            try:
+                return solve(x)
+            except RuntimeError as e:
+                log(e)
+                return None
+    """)
+    assert "silent-except" not in rule_ids(found)
+    # Pragma'd with justification: suppressed.
+    found = lint("""
+        def risky(dump, x):
+            try:
+                dump(x)
+            except Exception:  # tpulint: disable=silent-except -- diag
+                pass
+    """)
+    assert "silent-except" not in rule_ids(found)
+
+
+# -- engine mechanics ------------------------------------------------------
+
+def test_file_level_pragma_suppresses_whole_file():
+    found = lint("""
+        # tpulint: disable=silent-except
+        def a(x):
+            try:
+                x()
+            except Exception:
+                pass
+
+        def b(x):
+            try:
+                x()
+            except Exception:
+                pass
+    """)
+    assert "silent-except" not in rule_ids(found)
+
+
+def test_parse_error_is_a_finding_not_a_crash():
+    found = lint("def broken(:\n")
+    assert [f.rule for f in found] == ["parse-error"]
+
+
+def test_baseline_round_trip(tmp_path):
+    src = """
+        def risky(solve, x):
+            try:
+                return solve(x)
+            except Exception:
+                pass
+    """
+    findings = lint(src)
+    assert findings
+    bp = tmp_path / "baseline.json"
+    bp.write_text(json.dumps(engine.baseline_payload(findings)))
+    baseline = engine.load_baseline(str(bp))
+    new, old = engine.split_baselined(findings, baseline)
+    assert not new and len(old) == len(findings)
+    # A SECOND occurrence of the same key is new (multiset semantics)...
+    twice = findings + findings
+    new, old = engine.split_baselined(twice, baseline)
+    assert len(new) == len(findings) and len(old) == len(findings)
+    # ...and baseline matching survives a line shift (content-keyed).
+    shifted = lint("\n\n\n" + src)
+    new, _ = engine.split_baselined(shifted, baseline)
+    assert not new
+
+
+def test_baseline_version_mismatch_raises(tmp_path):
+    bp = tmp_path / "baseline.json"
+    bp.write_text(json.dumps({"version": 999, "findings": []}))
+    with pytest.raises(ValueError, match="version"):
+        engine.load_baseline(str(bp))
+
+
+def test_rule_registry_names_unique():
+    rules = all_rules()
+    names = [r.name for r in rules]
+    assert len(set(names)) == len(names) == 5
+    assert set(rules_by_name()) == {
+        "host-sync-in-jit", "recompile-hazard", "dtype-discipline",
+        "obs-in-hot-loop", "silent-except"}
+
+
+# -- the tier-1 gate -------------------------------------------------------
+
+def test_package_lints_clean_against_baseline():
+    """The pre-merge invariant: zero non-baselined findings over the
+    whole package.  A red run here means either fix the new violation
+    or (for a justified intentional pattern) add an inline pragma with
+    its reason -- NOT a baseline bump; the committed baseline stays the
+    legacy-debt ledger only (docs/static_analysis.md)."""
+    findings = engine.lint_paths([PACKAGE], root=REPO)
+    baseline = engine.load_baseline(BASELINE)
+    new, _ = engine.split_baselined(findings, baseline)
+    assert not new, "new tpulint findings:\n" + "\n".join(
+        f.render() for f in new)
+
+
+def test_cli_gates_seeded_violation_and_passes_package(tmp_path):
+    """scripts/tpulint.py exit contract: 1 on a seeded violation in a
+    fixture file, 0 on the package at HEAD with the committed
+    baseline."""
+    bad = tmp_path / "seeded.py"
+    bad.write_text(textwrap.dedent("""
+        import jax
+
+        @jax.jit
+        def kernel(x):
+            return float(x)
+    """))
+    script = os.path.join(REPO, "scripts", "tpulint.py")
+    r = subprocess.run([sys.executable, script, str(bad)],
+                       capture_output=True, text=True, cwd=REPO)
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "host-sync-in-jit" in r.stdout
+    r = subprocess.run([sys.executable, script],
+                       capture_output=True, text=True, cwd=REPO)
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_cli_refuses_repo_baseline_update_from_restricted_run(tmp_path):
+    """--update-baseline on the REPO baseline with explicit paths (or
+    --rules) would drop every other baselined entry; the CLI refuses
+    (exit 2).  Scoped updates against an explicit --baseline file stay
+    allowed (next test)."""
+    seed = tmp_path / "s.py"
+    seed.write_text("x = 1\n")
+    script = os.path.join(REPO, "scripts", "tpulint.py")
+    r = subprocess.run(
+        [sys.executable, script, str(seed), "--update-baseline"],
+        capture_output=True, text=True, cwd=REPO)
+    assert r.returncode == 2 and "refusing" in r.stderr
+    r = subprocess.run(
+        [sys.executable, script, "--rules", "silent-except",
+         "--update-baseline"],
+        capture_output=True, text=True, cwd=REPO)
+    assert r.returncode == 2 and "refusing" in r.stderr
+    # The committed baseline survived untouched.
+    with open(BASELINE) as fh:
+        assert json.load(fh)["findings"] == []
+
+
+def test_cli_update_baseline_round_trip(tmp_path):
+    bad = tmp_path / "seeded.py"
+    bad.write_text("def f(x):\n    try:\n        x()\n"
+                   "    except Exception:\n        pass\n")
+    script = os.path.join(REPO, "scripts", "tpulint.py")
+    bp = tmp_path / "b.json"
+    r = subprocess.run(
+        [sys.executable, script, str(bad), "--baseline", str(bp),
+         "--update-baseline"], capture_output=True, text=True, cwd=REPO)
+    assert r.returncode == 0, r.stdout + r.stderr
+    r = subprocess.run(
+        [sys.executable, script, str(bad), "--baseline", str(bp)],
+        capture_output=True, text=True, cwd=REPO)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "baselined" in r.stdout

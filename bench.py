@@ -30,9 +30,13 @@ BENCH_POINT_SCHEDULE ("nf32,nf64" aggressive point-class IPM schedule),
 BENCH_RESCUE (straggler re-solve iterations; see Oracle.rescue_iter) --
 those two apply to the batched AND serial oracles alike, so speedups
 keep isolating batching.  BENCH_TWO_PHASE=0/1, BENCH_PHASE1,
-BENCH_WARM=0/1 control the two-phase early-exit cohort and tree
-warm-starts (default ON; the serial baseline forces them off
-internally, staying the conservative fixed-schedule stand-in).  BENCH_LARGE_DEPTH / BENCH_SHARDS size the
+BENCH_PHASE1_POINT / BENCH_PHASE1_SIMPLEX (per-class first-phase
+overrides), BENCH_WARM=0/1 control the two-phase early-exit cohort and
+tree warm-starts (default ON; the serial baseline forces them off
+internally, staying the conservative fixed-schedule stand-in).
+BENCH_PIPELINE_DEPTH / BENCH_SPECULATE=0/1 / BENCH_DEDUP_WINDOW tune
+the build pipeline (partition/pipeline.py; bit-invisible to the
+produced tree).  BENCH_LARGE_DEPTH / BENCH_SHARDS size the
 large-L synthetic export + sharded-serving metric (large_l_metrics;
 depth 0 disables it).
 
@@ -326,10 +330,24 @@ def schedule_kwargs(result: dict | None = None) -> dict:
     kw["two_phase"] = tp != "0" if tp is not None else True
     if tp is not None:
         overrides["two_phase"] = kw["two_phase"]
+    # Phase-1 length knobs: 0 (like unset) means "auto" -- the 0-is-
+    # default convention the sibling BENCH_TWO_PHASE/BENCH_WARM toggles
+    # use -- rather than tripping the oracle's >= 1 validation.
+    # Negatives still flow through so the oracle rejects the typo.
     p1 = os.environ.get("BENCH_PHASE1")
-    if p1:
+    if p1 and int(p1) != 0:
         kw["phase1_iters"] = int(p1)
         overrides["phase1_iters"] = int(p1)
+    # Per-class phase-1 overrides (cfg.ipm_phase1_iters_point/_simplex):
+    # the point and joint-simplex classes converge at different rates,
+    # so their first-phase lengths tune independently; unset preserves
+    # the shared value / auto 2/5 split.
+    for env, kw_name in (("BENCH_PHASE1_POINT", "phase1_iters_point"),
+                         ("BENCH_PHASE1_SIMPLEX", "phase1_iters_simplex")):
+        v = os.environ.get(env)
+        if v and int(v) != 0:
+            kw[kw_name] = int(v)
+            overrides[kw_name] = int(v)
     wm = os.environ.get("BENCH_WARM")
     kw["warm_start"] = wm != "0" if wm is not None else True
     if wm is not None:
@@ -531,16 +549,38 @@ def run(result: dict, monitor: ContentionMonitor | None = None) -> None:
     # every BENCH_*.json carries solve-time p50/p99, IPM iteration
     # volume, and serving latencies -- the bench trajectory's trend data.
     build_obs = obs_lib.Obs("jsonl")
+    # Resolved per-class phase-1 splits (auto 2/5, shared override, or
+    # the per-class BENCH_PHASE1_POINT/_SIMPLEX knobs) ride the metrics
+    # block so every capture records the schedule it actually ran.
+    build_obs.gauge("oracle.ipm_phase1_iters_point").set(
+        getattr(oracle, "point_p1", 0))
+    build_obs.gauge("oracle.ipm_phase1_iters_simplex").set(
+        getattr(oracle, "simplex_p1", 0))
     # max_depth 56 (vs the engine default 40): the pendulum's
     # mode-boundary slivers certify by depth ~54, so the headline build
     # completes FULLY eps-certified instead of emitting best-effort
     # leaves at the cap (same default as scripts/north_star.py).
+    # Build-pipeline knobs (partition/pipeline.py): BENCH_PIPELINE_DEPTH
+    # (lookahead batches; 0 = synchronous), BENCH_SPECULATE=0/1
+    # (speculative child dispatch), BENCH_DEDUP_WINDOW (in-flight
+    # vertex-dedup cap).  Unset = shipping defaults; all three are
+    # bit-invisible to the produced tree.
+    pd_env = os.environ.get("BENCH_PIPELINE_DEPTH")
+    sp_env = os.environ.get("BENCH_SPECULATE")
+    dw_env = os.environ.get("BENCH_DEDUP_WINDOW")
+    pipe_kw = {}
+    if pd_env is not None:
+        pipe_kw["pipeline_depth"] = int(pd_env)
+    if sp_env is not None:
+        pipe_kw["speculate"] = sp_env != "0"
+    if dw_env is not None:
+        pipe_kw["dedup_window"] = int(dw_env)
     cfg = PartitionConfig(problem=problem_name, eps_a=eps_a,
                           backend="device", batch_simplices=batch,
                           max_steps=max_steps, precision=precision,
                           max_depth=int(os.environ.get("BENCH_MAX_DEPTH",
                                                        "56")),
-                          time_budget_s=budget)
+                          time_budget_s=budget, **pipe_kw)
     res = build_partition(problem, cfg, oracle=oracle, obs=build_obs)
     stats = res.stats
     n_point = oracle.n_point_solves
@@ -556,6 +596,17 @@ def run(result: dict, monitor: ContentionMonitor | None = None) -> None:
                   inherited_skips=stats["inherited_skips"],
                   masked_point_skips=stats["masked_point_skips"],
                   prefetched_steps=stats["prefetched_steps"],
+                  # Build-pipeline economy (partition/pipeline.py):
+                  # lookahead occupancy, speculative-dispatch precision
+                  # and waste, and the point solves the cross-batch
+                  # dedup window avoided.  Gated by bench_gate.py
+                  # (pipeline_fill_frac higher-is-better,
+                  # spec_waste_frac lower-is-better).
+                  pipeline_depth=stats["pipeline_depth"],
+                  pipeline_fill_frac=stats["pipeline_fill_frac"],
+                  dedup_saved=stats["dedup_saved"],
+                  spec_hit_rate=stats["spec_hit_rate"],
+                  spec_waste_frac=stats["spec_waste_frac"],
                   wall_s=round(stats["wall_s"], 2),
                   truncated=stats["truncated"],
                   uncertified=stats["uncertified"],

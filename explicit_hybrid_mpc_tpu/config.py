@@ -91,6 +91,13 @@ class PartitionConfig:
     # class to its f64 schedule length); None = 2/5 of the class
     # schedule.
     ipm_phase1_iters: Optional[int] = None
+    # Per-class phase-1 overrides (PR 3 follow-up: the point-class and
+    # joint-simplex programs converge at very different rates, and one
+    # shared phase1_iters forces a compromise -- wasted_iter_frac 0.27
+    # on the tier-1 bench).  Each overrides ipm_phase1_iters for its
+    # class only; None preserves the shared value / auto 2/5 split.
+    ipm_phase1_iters_point: Optional[int] = None
+    ipm_phase1_iters_simplex: Optional[int] = None
     # Tree warm-starts (Oracle(warm_start=...)): cache the oracle's
     # final duals/slacks per vertex row and feed a cached sibling
     # vertex's iterates as the IPM start for new bisection midpoints,
@@ -98,12 +105,52 @@ class PartitionConfig:
     # start, so certificates cannot degrade -- only iteration counts
     # change).
     warm_start_tree: bool = True
-    # Dispatch the next frontier batch's point solves while the host
+    # Dispatch future frontier batches' point solves while the host
     # certifies the current batch (jax async dispatch; results consumed
-    # next step).  Deterministic: the prefetched plan is exactly the plan
-    # the next step would compute.  False forces the strictly-synchronous
-    # solve -> certify -> solve loop.
+    # when their step commits).  False forces the strictly-synchronous
+    # solve -> certify -> solve loop and is the legacy kill switch:
+    # prefetch_solves=False == pipeline_depth=0, and prefetch_solves=True
+    # with pipeline_depth=1 reproduces the old single-slot prefetch.
     prefetch_solves: bool = True
+    # Bounded asynchronous build pipeline (partition/pipeline.py): up to
+    # this many frontier batches are planned AND dispatched ahead of the
+    # committing step, so plan(k+2)/dispatch(k+2) run while wait(k+1)
+    # resolves and commit(k) writes the tree.  Commits stay strictly
+    # ordered and every step re-plans authoritatively against the
+    # serial-point cache state before writing rows, so the produced
+    # tree is node-for-node BIT-IDENTICAL to the pipeline_depth=0 build
+    # at any depth (same regions, vertex matrices, leaf commutations
+    # and statuses; see partition/pipeline.py for the one caveat on
+    # last-ulp payload floats served across pow-2 buckets).  Only
+    # full-size batches are claimed ahead (a partial batch's membership
+    # depends on in-flight verdicts); 0 = fully synchronous.
+    pipeline_depth: int = 2
+    # Speculative child dispatch: when the inherited-gap heuristic
+    # predicts a frontier cell will SPLIT (inherited certificate gap
+    # INFINITE -- the mixed-feasibility boundary population, the only
+    # one whose re-split is predictable; finite-gap children re-split
+    # at ~0.49 regardless of magnitude), its children's shared new
+    # vertex (the longest-edge bisection midpoint) is dispatched before
+    # the cell's own verdict lands.  A hit overlaps the child's point
+    # solves with host certification; a mis-speculation is dropped
+    # before commit, so the tree stays bit-identical either way and
+    # only spec_waste grows.  Speculation is an idle-device filler: it
+    # self-gates on the rolling device-busy fraction
+    # (pipeline.SPEC_DEVICE_FRAC_MAX) and stays dormant while the
+    # device is already the bottleneck.  Requires pipeline_depth >= 1;
+    # eps_r-only builds never speculate (the predictor was only
+    # validated on eps_a builds).
+    speculate: bool = True
+    # Cross-batch vertex-dedup window (partition/pipeline.py): maximum
+    # distinct in-flight vertices whose dispatched (delta, vertex)
+    # programs are tracked for coalescing.  Duplicate requests across
+    # the whole in-flight window (sibling bisection midpoints, batch-
+    # boundary overlaps the old prefetch re-solved) collapse into one
+    # device solve fanned back out to every requester.  A full window
+    # refuses new lookahead/speculative admissions (those batches just
+    # solve synchronously at their commit) -- correctness is
+    # unaffected.
+    dedup_window: int = 8192
     # Inherit per-commutation stage-2 facts (Farkas infeasibility
     # exclusions, simplex-min lower bounds) from parent to children across
     # bisections.  Certified-exact decision parity with the uninherited
@@ -208,6 +255,17 @@ class PartitionConfig:
         if self.ipm_phase1_iters is not None and self.ipm_phase1_iters < 1:
             raise ValueError("ipm_phase1_iters must be >= 1 (or None for "
                              "the automatic 2/5 split)")
+        for fld in ("ipm_phase1_iters_point", "ipm_phase1_iters_simplex"):
+            v = getattr(self, fld)
+            if v is not None and v < 1:
+                raise ValueError(f"{fld} must be >= 1 (or None to "
+                                 "inherit ipm_phase1_iters / the auto "
+                                 "split)")
+        if self.pipeline_depth < 0:
+            raise ValueError("pipeline_depth must be >= 0 "
+                             "(0 = synchronous build)")
+        if self.dedup_window < 1:
+            raise ValueError("dedup_window must be >= 1")
         if self.recompile_guard not in ("off", "warn", "raise"):
             raise ValueError(f"unknown recompile_guard "
                              f"{self.recompile_guard!r} (expected 'off', "

@@ -62,9 +62,30 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--phase1-iters", type=int, default=None, metavar="N",
                    help="f64 iterations in the cohort's first phase "
                    "(default: 2/5 of each class's f64 schedule)")
+    p.add_argument("--phase1-iters-point", type=int, default=None,
+                   metavar="N",
+                   help="per-class override of --phase1-iters for the "
+                   "POINT-class programs only")
+    p.add_argument("--phase1-iters-simplex", type=int, default=None,
+                   metavar="N",
+                   help="per-class override of --phase1-iters for the "
+                   "joint elastic-simplex programs only")
     p.add_argument("--no-warm-start", action="store_true",
                    help="disable tree warm-starts (cold-start every "
                    "child-vertex QP)")
+    p.add_argument("--pipeline-depth", type=int, default=None,
+                   metavar="N",
+                   help="frontier batches planned + dispatched ahead of "
+                   "the committing step (default 2; 0 = strictly "
+                   "synchronous; the produced tree is bit-identical at "
+                   "any depth)")
+    p.add_argument("--no-speculate", action="store_true",
+                   help="disable speculative child dispatch (midpoint "
+                   "solves of predicted splits issued before the "
+                   "certificate verdict)")
+    p.add_argument("--dedup-window", type=int, default=None, metavar="K",
+                   help="max in-flight vertices tracked for cross-batch "
+                   "solve dedup (default 8192)")
     p.add_argument("--max-steps", type=int, default=10_000)
     p.add_argument("--checkpoint-every", type=int, default=0, metavar="K",
                    help="snapshot frontier+tree every K steps")
@@ -211,7 +232,14 @@ def main(argv: list[str] | None = None) -> int:
         prune_rows=args.prune_rows,
         ipm_two_phase=not args.no_two_phase,
         ipm_phase1_iters=args.phase1_iters,
+        ipm_phase1_iters_point=args.phase1_iters_point,
+        ipm_phase1_iters_simplex=args.phase1_iters_simplex,
         warm_start_tree=not args.no_warm_start,
+        **({"pipeline_depth": args.pipeline_depth}
+           if args.pipeline_depth is not None else {}),
+        speculate=not args.no_speculate,
+        **({"dedup_window": args.dedup_window}
+           if args.dedup_window is not None else {}),
         max_steps=args.max_steps,
         checkpoint_every=args.checkpoint_every,
         checkpoint_path=(f"{prefix}.ckpt.pkl"
@@ -260,6 +288,8 @@ def main(argv: list[str] | None = None) -> int:
         # silently switch conv patterns at the resume point.
         for fld, legacy in (("ipm_two_phase", False),
                             ("ipm_phase1_iters", None),
+                            ("ipm_phase1_iters_point", None),
+                            ("ipm_phase1_iters_simplex", None),
                             ("warm_start_tree", False)):
             if fld not in snap_cfg.__dict__:
                 object.__setattr__(snap_cfg, fld, legacy)
@@ -267,6 +297,7 @@ def main(argv: list[str] | None = None) -> int:
                     "algorithm", "backend", "precision",
                     "ipm_point_schedule", "ipm_rescue_iters",
                     "ipm_two_phase", "ipm_phase1_iters",
+                    "ipm_phase1_iters_point", "ipm_phase1_iters_simplex",
                     "warm_start_tree",
                     "batch_simplices", "max_depth",
                     "semi_explicit_boundary_depth", "prune_rows"):
@@ -279,8 +310,16 @@ def main(argv: list[str] | None = None) -> int:
         # Obs knobs stay with THIS run (output-class flags, like the
         # log/profile paths; snapshots predating the knobs resolve
         # through the dataclass's class-level defaults).
+        # Pipeline knobs are run-scoped like the obs flags: pipelining,
+        # speculation, and dedup are bit-invisible to the produced tree
+        # (partition/pipeline.py), so resuming with different lookahead
+        # settings changes only throughput, never results.
         cfg = dataclasses.replace(
             snap_cfg, log_path=cfg.log_path,
+            prefetch_solves=cfg.prefetch_solves,
+            pipeline_depth=cfg.pipeline_depth,
+            speculate=cfg.speculate,
+            dedup_window=cfg.dedup_window,
             max_steps=cfg.max_steps,
             checkpoint_every=cfg.checkpoint_every,
             checkpoint_path=cfg.checkpoint_path,

@@ -339,6 +339,8 @@ class Oracle:
                  stage2_order: str = "auto",
                  two_phase: bool = False,
                  phase1_iters: int | None = None,
+                 phase1_iters_point: int | None = None,
+                 phase1_iters_simplex: int | None = None,
                  warm_start: bool = False,
                  obs: "obs_lib.Obs | None" = None):
         """mesh: optional jax.sharding.Mesh with ("batch", "delta") axes;
@@ -377,7 +379,11 @@ class Oracle:
 
         phase1_iters: f64 iterations in the cohort's first phase
         (clamped per class to its f64 length); None = 2/5 of the class
-        schedule.
+        schedule.  phase1_iters_point / phase1_iters_simplex override
+        it PER CLASS (cfg.ipm_phase1_iters_point/_simplex): the point
+        QPs and the joint elastic-simplex programs converge at very
+        different rates, so their first-phase lengths can be tuned
+        independently; None inherits the shared value / auto split.
 
         warm_start: accept caller-supplied warm starts on the pair path
         (dispatch_pairs(..., warm=...)) and return final duals/slacks
@@ -462,6 +468,14 @@ class Oracle:
             raise ValueError(f"phase1_iters={phase1_iters} must be >= 1")
         self.phase1_iters = (None if phase1_iters is None
                              else int(phase1_iters))
+        for nm, v in (("phase1_iters_point", phase1_iters_point),
+                      ("phase1_iters_simplex", phase1_iters_simplex)):
+            if v is not None and int(v) < 1:
+                raise ValueError(f"{nm}={v} must be >= 1")
+        self.phase1_iters_point = (None if phase1_iters_point is None
+                                   else int(phase1_iters_point))
+        self.phase1_iters_simplex = (None if phase1_iters_simplex is None
+                                     else int(phase1_iters_simplex))
         self.two_phase = bool(two_phase)
         self.warm_start = bool(warm_start)
         if backend == "serial" or mesh is not None:
@@ -470,19 +484,24 @@ class Oracle:
             self.two_phase = False
             self.warm_start = False
 
-        def _split(n_f64: int) -> tuple[int, int]:
+        def _split(n_f64: int,
+                   override: int | None) -> tuple[int, int]:
             # Auto split: 2/5 of the class's f64 leg in phase 1.
             # Measured on the tier-1 pendulum (mixed, warm-starts on):
             # 2/5 (4 of 10) saves 27% of fixed f64 iterations vs 21%
             # for 3/5 -- warm starts + the diverged-cell early exit
             # keep the survivor set small enough that the shorter
-            # first leg pays.
-            p1 = min(n_f64, self.phase1_iters
-                     if self.phase1_iters is not None
+            # first leg pays.  A per-class override wins over the
+            # shared phase1_iters, which wins over the auto split.
+            if override is None:
+                override = self.phase1_iters
+            p1 = min(n_f64, override if override is not None
                      else max(1, (2 * n_f64) // 5))
             return p1, n_f64 - p1
-        self.point_p1, self.point_p2 = _split(self.point_n_iter)
-        self.simplex_p1, self.simplex_p2 = _split(self.n_iter)
+        self.point_p1, self.point_p2 = _split(self.point_n_iter,
+                                              self.phase1_iters_point)
+        self.simplex_p1, self.simplex_p2 = _split(
+            self.n_iter, self.phase1_iters_simplex)
         # Degenerate splits (phase1_iters >= class schedule) fall back to
         # the single-phase path for that class.
         self._point_cohort = self.two_phase and self.point_p2 > 0
@@ -681,6 +700,8 @@ class Oracle:
             # must be what the main oracle would have produced.
             two_phase=self.two_phase,
             phase1_iters=self.phase1_iters,
+            phase1_iters_point=self.phase1_iters_point,
+            phase1_iters_simplex=self.phase1_iters_simplex,
             warm_start=self.warm_start)
 
     # -- iteration ledger + metrics --------------------------------------

@@ -23,6 +23,13 @@ Per step:
 Termination: frontier empty (all leaves certified / infeasible / depth-
 capped).  The frontier + cache + tree snapshot to disk every
 cfg.checkpoint_every steps and any run can resume (SURVEY.md section 6.4).
+
+Steps are scheduled by the bounded asynchronous build pipeline
+(partition/pipeline.py): up to cfg.pipeline_depth future batches are
+planned and dispatched while earlier steps wait/certify/commit, with
+cross-batch vertex dedup and speculative child dispatch -- node-for-node
+identical trees at any depth, enforced by an authoritative commit-time
+re-plan.
 """
 
 from __future__ import annotations
@@ -36,10 +43,115 @@ import numpy as np
 
 from explicit_hybrid_mpc_tpu import obs as obs_lib
 from explicit_hybrid_mpc_tpu.config import PartitionConfig
-from explicit_hybrid_mpc_tpu.oracle.oracle import Oracle, VertexSolution
+from explicit_hybrid_mpc_tpu.oracle.oracle import Oracle
 from explicit_hybrid_mpc_tpu.partition import certify, geometry
+from explicit_hybrid_mpc_tpu.partition.pipeline import BuildPipeline
 from explicit_hybrid_mpc_tpu.partition.tree import LeafData, Tree
 from explicit_hybrid_mpc_tpu.utils.logging import RunLog
+
+
+def _donor_warm(drow, ds: np.ndarray):
+    """Warm-start slices (z, lam, s, has) for pair cells `ds` from donor
+    row `drow` -- shared by the real planner and the speculative child
+    planner so their bit-for-bit warm data can never drift.
+
+    Centrality floor (Mehrotra-style shifted warm start): a converged
+    donor sits ON the boundary (active s_i, inactive lam_i ~ 1e-9), and
+    an IPM started there crawls -- the merit gate cannot see centrality,
+    only residuals.  Flooring slacks/duals at 1e-2 re-centers the start
+    while keeping the donor's primal point; measured: restores warm
+    convergence rates to >= cold everywhere (two-phase continuations are
+    NOT floored -- they must resume the exact iterate).  Only converged
+    donor cells with live duals are offered (rescued cells carry NaN
+    donor slots -- the rescue program returns no duals; diverged
+    iterates are junk the gate would reject anyway)."""
+    return (drow[4][ds],
+            np.maximum(drow[8][ds], 1e-2),
+            np.maximum(drow[9][ds], 1e-2),
+            np.asarray(drow[1][ds], dtype=bool)
+            & np.isfinite(drow[8][ds, 0]))
+
+
+class _PlanBuilder:
+    """Accumulates a solve plan's dense-grid and sparse-pair cells and
+    stacks them ONCE into the plan dict -- shared by the authoritative
+    planner (_plan_missing) and the speculative child planner
+    (_plan_spec_children) so the two can never drift: the pipeline's
+    serve-time route match assumes both assemble cells, warm slices,
+    and batch layout bit-identically.  (Stacking once also matters for
+    host cost: dispatch re-stacking per-element python lists was the
+    largest host cost of pure-splitting phases, ~6k np.asarray calls
+    per step via np.stack.)"""
+
+    def __init__(self, can, use_warm: bool):
+        self._can = can
+        self._use_warm = use_warm
+        self.grid_keys: list[bytes] = []
+        self._grid_pts: list[np.ndarray] = []
+        self._pair_verts: list[np.ndarray] = []
+        self._pair_ds: list[np.ndarray] = []
+        # z / s / lam / has, the wire order of Oracle.dispatch_pairs'
+        # warm tuple.
+        self._warm: tuple[list, list, list, list] = ([], [], [], [])
+        # (key, delta indices, offset into the pair batch)
+        self.pair_slices: list[tuple[bytes, np.ndarray, int]] = []
+        # Donor ROW OBJECTS aligned with pair_slices: the pipeline's
+        # route match compares the authoritative donor against the one
+        # an in-flight program was dispatched with (a widened cache row
+        # replaces the tuple, so identity is an exact staleness check).
+        self.pair_donors: list[tuple | None] = []
+        self._n_pair = 0
+
+    @property
+    def empty(self) -> bool:
+        return not self._grid_pts and not self.pair_slices
+
+    @property
+    def n_grid(self) -> int:
+        return len(self._grid_pts)
+
+    def add_grid(self, k: bytes, v: np.ndarray) -> None:
+        self._grid_pts.append(v)
+        self.grid_keys.append(k)
+
+    def add_pair(self, k: bytes, ds: np.ndarray, v: np.ndarray,
+                 drow) -> None:
+        self.pair_slices.append((k, ds, self._n_pair))
+        self.pair_donors.append(drow)
+        self._pair_verts.append(v)
+        self._pair_ds.append(ds)
+        if self._use_warm:
+            if drow is not None:
+                zw, lw, sw, hw = _donor_warm(drow, ds)
+            else:
+                zw = np.zeros((ds.size, self._can.nz))
+                lw = np.zeros((ds.size, self._can.nc))
+                sw = np.zeros((ds.size, self._can.nc))
+                hw = np.zeros(ds.size, dtype=bool)
+            self._warm[0].append(zw)
+            self._warm[1].append(sw)
+            self._warm[2].append(lw)
+            self._warm[3].append(hw)
+        self._n_pair += ds.size
+
+    def finish(self, n_skips: int, n_new: int) -> dict:
+        grid_arr = np.stack(self._grid_pts) if self._grid_pts else None
+        pair_warm = None
+        if self.pair_slices:
+            counts = np.asarray([d.size for d in self._pair_ds])
+            pair_t = np.repeat(np.stack(self._pair_verts), counts,
+                               axis=0)
+            pair_d = np.concatenate(self._pair_ds).astype(np.int64)
+            if self._use_warm:
+                pair_warm = tuple(np.concatenate(w) for w in self._warm)
+        else:
+            pair_t = pair_d = None
+        return {"grid_arr": grid_arr, "grid_keys": self.grid_keys,
+                "pair_t": pair_t, "pair_d": pair_d,
+                "pair_warm": pair_warm,
+                "pair_slices": self.pair_slices,
+                "pair_donors": self.pair_donors,
+                "n_skips": n_skips, "n_new": n_new}
 
 
 class VertexCache:
@@ -135,10 +247,6 @@ class FrontierEngine:
         self.n_unique_solves = 0
         self.n_device_failures = 0
         self.n_point_skips = 0
-        self.n_prefetched_steps = 0
-        # In-flight prefetched solve for the next batch:
-        # (nodes tuple, plan, grid handle, pair handle) or None.
-        self._prefetch = None
         # Interned all-True active-delta mask (shared by every full cache
         # row; never mutated -- partial masks are fresh copies).
         self._full_mask = np.ones(oracle.can.n_delta, dtype=bool)
@@ -153,6 +261,11 @@ class FrontierEngine:
         # re-solves -- the cache is a cache, correctness is unaffected).
         self._refcount: collections.Counter[bytes] = collections.Counter()
         self._node_keys = {}
+        # Rolling device-busy fraction of recent steps (EMA of
+        # device_frac): the pipeline's speculation gate reads it --
+        # speculative batches are idle-device fillers and are skipped
+        # while the device is already the bottleneck.
+        self.device_frac_ema = 0.0
         for n in self.roots:
             self._retain(n)
         # node -> {delta: lower bound on min_R V_delta} inherited from
@@ -175,6 +288,11 @@ class FrontierEngine:
         # subtrees; this inheritance removes that re-proving.
         self._inherit: dict[int, dict[int, float]] = {}
         self.n_inherited_skips = 0
+        # Bounded asynchronous build pipeline (partition/pipeline.py):
+        # depth-N in-flight batch scheduling, cross-batch vertex dedup,
+        # speculative child dispatch.  cfg.prefetch_solves=False is the
+        # legacy kill switch (depth 0 = strictly synchronous).
+        self._pipe = BuildPipeline(self)
 
     # -- diagnostics: flight recorder + in-stream health monitor -----------
 
@@ -445,7 +563,48 @@ class FrontierEngine:
 
     # -- vertex solves -----------------------------------------------------
 
-    def _plan_missing(self, nodes: list[int]) -> dict | None:
+    def _use_mask(self) -> bool:
+        """Whether planning may skip ancestor-Farkas-excluded point QPs
+        (cfg.mask_point_solves; mesh oracles keep the dense grid)."""
+        return (self.oracle.can.n_delta > 1 and self.oracle.mesh is None
+                and getattr(self.cfg, "mask_point_solves", True)
+                and getattr(self.cfg, "inherit_bounds", True))
+
+    def _use_warm(self) -> bool:
+        """Whether planning attaches tree warm-start donors
+        (cfg.warm_start_tree on a warm-capable oracle)."""
+        return (getattr(self.oracle, "warm_start", False)
+                and getattr(self.cfg, "warm_start_tree", True))
+
+    def _active_delta_mask(self, n: int, use_mask: bool) -> np.ndarray:
+        """Active-commutation mask of node n: all minus the inherited
+        Farkas +inf exclusions.  Returns self._full_mask ITSELF when
+        nothing is excluded -- planning relies on the identity to merge
+        per-key needs cheaply."""
+        full = self._full_mask
+        if use_mask and n in self._inherit:
+            excl = [d for d, b in self._inherit[n].items()
+                    if b == np.inf]
+            if excl:
+                act = full.copy()
+                act[excl] = False
+                return act
+        return full
+
+    def _pick_donor(self, keys) -> tuple | None:
+        """First cached vertex among `keys` that carries duals.  The ONE
+        donor pick shared by the authoritative planner and the
+        speculative child planner: if the two ever diverged, every
+        speculative program would carry a donor the claiming plan never
+        picks and pipeline._match_cell would route-miss all of it."""
+        for k in keys:
+            r = self.cache.get_key(k)
+            if r is not None and len(r) > 8 and r[8] is not None:
+                return r
+        return None
+
+    def _plan_missing(self, nodes: list[int],
+                      window: "BuildPipeline | None" = None) -> dict | None:
         """Decide every (vertex, commutation) cell the certificates of
         `nodes` can read but the cache does not hold.
 
@@ -473,30 +632,29 @@ class FrontierEngine:
         the donor cell converged).  Correctness never depends on the
         donor: the kernel's merit gate falls back to the cold start.
 
-        Returns a plan dict for _dispatch_plan/_consume_plan, or None if
-        the cache already holds everything.  Planning only reads state
-        that is stable between frontier steps (cache rows, inherited
-        exclusions of OPEN nodes), which is what makes prefetch planning
-        at the end of step k valid for step k+1."""
+        Returns a plan dict, or None if the cache already holds
+        everything.  Planning only reads state that is stable between
+        frontier steps (cache rows, inherited exclusions of OPEN
+        nodes), which is what makes lookahead planning during step k
+        valid for steps k+1..k+depth.
+
+        window: the BuildPipeline for TENTATIVE fill-time plans --
+        (vertex, delta) cells an in-flight program already covers with
+        a route-compatible solve are skipped (cross-batch dedup; real
+        coverage tallies window.dedup_saved).  The AUTHORITATIVE
+        commit-time plan passes None: it is computed against exactly
+        the cache state the synchronous build would see and defines the
+        bit-exact results; the pipeline serves it from the window only
+        under a per-cell route match (pipeline.serve)."""
         nd = self.oracle.can.n_delta
-        can = self.oracle.can
         full = self._full_mask
-        use_mask = (nd > 1 and self.oracle.mesh is None
-                    and getattr(self.cfg, "mask_point_solves", True)
-                    and getattr(self.cfg, "inherit_bounds", True))
-        use_warm = (getattr(self.oracle, "warm_start", False)
-                    and getattr(self.cfg, "warm_start_tree", True))
+        use_mask = self._use_mask()
+        use_warm = self._use_warm()
         need: dict[bytes, np.ndarray] = {}
         vert: dict[bytes, np.ndarray] = {}
         donor: dict[bytes, tuple] = {}
         for n in nodes:
-            act = full
-            if use_mask and n in self._inherit:
-                excl = [d for d, b in self._inherit[n].items()
-                        if b == np.inf]
-                if excl:
-                    act = full.copy()
-                    act[excl] = False
+            act = self._active_delta_mask(n, use_mask)
             keys = self._keys(n)
             for k, v in zip(keys, self.tree.vertices[n]):
                 cur = need.get(k)
@@ -509,27 +667,13 @@ class FrontierEngine:
                 # First cached vertex of this node that carries duals:
                 # deterministic (node order x key order), so builds stay
                 # reproducible run-to-run.
-                drow = None
-                for k2 in keys:
-                    r = self.cache.get_key(k2)
-                    if r is not None and len(r) > 8 and r[8] is not None:
-                        drow = r
-                        break
+                drow = self._pick_donor(keys)
                 if drow is not None:
                     for k2 in keys:
                         if k2 not in donor:
                             donor[k2] = drow
-        grid_pts: list[np.ndarray] = []
-        grid_keys: list[bytes] = []
-        pair_verts: list[np.ndarray] = []
-        pair_ds: list[np.ndarray] = []
-        warm_z: list[np.ndarray] = []
-        warm_s: list[np.ndarray] = []
-        warm_l: list[np.ndarray] = []
-        warm_h: list[np.ndarray] = []
-        # (key, delta indices, offset into the pair batch)
-        pair_slices: list[tuple[bytes, np.ndarray, int]] = []
-        n_pair = n_skips = n_new = 0
+        pb = _PlanBuilder(self.oracle.can, use_warm)
+        n_skips = n_new = 0
         for k, m in need.items():
             row = self.cache.get_key(k)
             drow = donor.get(k) if use_warm else None
@@ -541,13 +685,24 @@ class FrontierEngine:
                     # gathers vs the grid's shared-delta vmap), while
                     # warm starts matter most in the masked deep tail
                     # whose cells already travel the pair path below.
-                    grid_pts.append(vert[k])
-                    grid_keys.append(k)
+                    if window is not None and window.covers_grid(k):
+                        continue  # in-flight grid program covers it
+                    pb.add_grid(k, vert[k])
                     continue
                 missing_d = m
                 n_skips += int(nd - m.sum())
             else:
                 missing_d = m & ~row[7]
+                if not missing_d.any():
+                    continue
+            if window is not None:
+                cov = window.cover_masks(k, drow, nd)
+                if cov is not None:
+                    real, spec = cov
+                    saved = missing_d & real
+                    if saved.any():
+                        window.dedup_saved += int(saved.sum())
+                    missing_d = missing_d & ~(real | spec)
                 if not missing_d.any():
                     continue
             ds = np.where(missing_d)[0]
@@ -557,179 +712,129 @@ class FrontierEngine:
                 # distinct vertices ever solved, same meaning as the
                 # unmasked build's.
                 n_new += 1
-            pair_slices.append((k, ds, n_pair))
-            pair_verts.append(vert[k])
-            pair_ds.append(ds)
-            if use_warm:
-                if drow is not None:
-                    warm_z.append(drow[4][ds])
-                    # Centrality floor (Mehrotra-style shifted warm
-                    # start): a converged donor sits ON the boundary
-                    # (active s_i, inactive lam_i ~ 1e-9), and an IPM
-                    # started there crawls -- the merit gate cannot see
-                    # centrality, only residuals.  Flooring slacks/duals
-                    # at 1e-2 re-centers the start while keeping the
-                    # donor's primal point; measured: restores warm
-                    # convergence rates to >= cold everywhere (two-phase
-                    # continuations are NOT floored -- they must resume
-                    # the exact iterate).
-                    warm_l.append(np.maximum(drow[8][ds], 1e-2))
-                    warm_s.append(np.maximum(drow[9][ds], 1e-2))
-                    # Offer only converged donor cells with live duals
-                    # (rescued cells carry NaN donor slots -- the rescue
-                    # program returns no duals; diverged iterates are
-                    # junk the gate would reject anyway).
-                    warm_h.append(np.asarray(drow[1][ds], dtype=bool)
-                                  & np.isfinite(drow[8][ds, 0]))
-                else:
-                    warm_z.append(np.zeros((ds.size, can.nz)))
-                    warm_l.append(np.zeros((ds.size, can.nc)))
-                    warm_s.append(np.zeros((ds.size, can.nc)))
-                    warm_h.append(np.zeros(ds.size, dtype=bool))
-            n_pair += ds.size
-        if not grid_pts and not pair_slices:
+            pb.add_pair(k, ds, vert[k], drow)
+        if pb.empty:
             return None
-        # Batches are stacked ONCE here (np.repeat over the unique-vertex
-        # stack for the pair rows): dispatch re-stacking per-element
-        # python lists -- and consume stacking them AGAIN for the
-        # fallback args -- was the largest host cost of pure-splitting
-        # phases (~6k np.asarray calls per step via np.stack).
-        grid_arr = np.stack(grid_pts) if grid_pts else None
-        pair_warm = None
-        if pair_slices:
-            counts = np.asarray([d.size for d in pair_ds])
-            pair_t = np.repeat(np.stack(pair_verts), counts, axis=0)
-            pair_d = np.concatenate(pair_ds).astype(np.int64)
+        return pb.finish(n_skips, n_new + pb.n_grid)
+
+    def _plan_spec_children(self, nodes: list[int],
+                            window: "BuildPipeline"
+                            ) -> tuple[dict, dict] | None:
+        """Speculative plan for the bisection midpoints of `nodes`'
+        predicted children (pipeline.speculate).
+
+        Each node's longest-edge bisection is deterministic, so the
+        children's shared new vertex -- and the exact plan the children's
+        own claim would produce for it -- is computable before the
+        node's verdict: active-delta mask from the node's inherited
+        exclusions (the children inherit a superset of them), route by
+        the same grid-vs-pair rule as _plan_missing, and the warm donor
+        by the same first-cached-with-duals scan over the LEFT child's
+        key order (the left child is appended to the frontier first, so
+        it is the first requester whose donor pick sticks).  A route or
+        donor that drifts by commit time is caught by the pipeline's
+        serve-time match and re-solved -- speculation can only waste
+        device work, never change a cache row.
+
+        Returns (plan dict shaped like _plan_missing's, {key: owner
+        node}), or None when nothing is worth dispatching."""
+        use_mask = self._use_mask()
+        use_warm = self._use_warm()
+        pb = _PlanBuilder(self.oracle.can, use_warm)
+        owners: dict[bytes, int] = {}
+        for n in nodes:
+            left, _right, _i, _j, mid = geometry.bisect(
+                self.tree.vertices[n])
+            k = geometry.vertex_key(mid)
+            if k in owners or window.has_entry(k):
+                continue  # already in flight (dedup)
+            row = self.cache.get_key(k)
+            act = self._active_delta_mask(n, use_mask)
+            missing = act if row is None else (act & ~row[7])
+            if not missing.any():
+                continue
+            owners[k] = n
+            if row is None and missing.all():
+                pb.add_grid(k, mid)
+                continue
+            ds = np.where(missing)[0]
+            drow = None
             if use_warm:
-                pair_warm = (np.concatenate(warm_z),
-                             np.concatenate(warm_s),
-                             np.concatenate(warm_l),
-                             np.concatenate(warm_h))
-        else:
-            pair_t = pair_d = None
-        return {"grid_arr": grid_arr, "grid_keys": grid_keys,
-                "pair_t": pair_t, "pair_d": pair_d,
-                "pair_warm": pair_warm,
-                "pair_slices": pair_slices,
-                "n_skips": n_skips, "n_new": n_new + len(grid_pts)}
+                # The children's donor pick, replayed ahead of time:
+                # every left-child vertex except the midpoint itself is
+                # already cached (the node's own batch just consumed),
+                # so the scan sees what the claiming plan will see.
+                drow = self._pick_donor(geometry.vertex_keys(left))
+            pb.add_pair(k, ds, mid, drow)
+        if pb.empty:
+            return None
+        return pb.finish(0, 0), owners
 
-    def _dispatch_plan(self, plan: dict | None) -> tuple:
-        """Issue the plan's device programs without blocking (jax async
-        dispatch).  A dispatch-time device error is recorded in the
-        handle; _consume_plan reroutes that part to the CPU fallback."""
-        if plan is None:
-            return (None, None)
-        gh = ph = None
-        t0 = time.perf_counter()
-        try:
-            with self.obs.span("build.dispatch"):
-                if plan["grid_arr"] is not None:
-                    gh = self.oracle.dispatch_vertices(plan["grid_arr"])
-                if plan["pair_slices"]:
-                    # The warm kwarg is passed only when donor data was
-                    # planned: legacy oracles (and test doubles) keep
-                    # the two-argument signature.
-                    if plan.get("pair_warm") is not None:
-                        ph = self.oracle.dispatch_pairs(
-                            plan["pair_t"], plan["pair_d"],
-                            warm=plan["pair_warm"])
-                    else:
-                        ph = self.oracle.dispatch_pairs(plan["pair_t"],
-                                                        plan["pair_d"])
-        except (RuntimeError, OSError) as e:
-            # Mark BOTH parts failed: a raising tunnel rarely delivers
-            # the part that did not raise, and the fallback recomputes
-            # deterministically either way.
-            gh = ph = ("failed", e)
-        finally:
-            self._oracle_s += time.perf_counter() - t0
-        return (gh, ph)
-
-    def _consume_plan(self, plan: dict | None, gh, ph) -> None:
-        """Block on the dispatched programs and write the cache rows.
-        Device failures (at dispatch or while transferring) retry the
-        SAME deterministic batch on the CPU fallback oracle, preserving
-        build parity (SURVEY.md section 6.3)."""
-        if plan is None:
-            return
+    def _merge_plan_results(self, plan: dict, sol, pair_out) -> None:
+        """Write an authoritative plan's resolved results into the
+        cache.  `sol` / `pair_out` are shaped exactly like the oracle's
+        wait_vertices / wait_pairs_full outputs whether they came from
+        a direct wait, the pipeline window, or a mix (pipeline.serve):
+        this is the ONE row-writing path, so pipelined and synchronous
+        builds cannot diverge here."""
         nd = self.oracle.can.n_delta
         full = self._full_mask
         self.n_unique_solves += plan["n_new"]
         self.n_point_skips += plan["n_skips"]
-        t0 = time.perf_counter()
-        try:
-            full_out = getattr(self.oracle, "_point_full_out", False)
-            nc = self.oracle.can.nc
-            if plan["grid_arr"] is not None:
-                # Span = the device-blocking wait: wall >> cpu here is
-                # the per-step device_frac signal at span granularity.
-                with self.obs.span("build.wait_vertices"):
-                    sol: VertexSolution = self._wait_or_fallback(
-                        "vertices", gh, (plan["grid_arr"],))
-                have_duals = sol.lam is not None
-                for i, k in enumerate(plan["grid_keys"]):
-                    self.cache.put_key(
-                        k, (sol.V[i], sol.conv[i], sol.grad[i], sol.u0[i],
-                            sol.z[i], sol.Vstar[i], sol.dstar[i], full,
-                            sol.lam[i] if have_duals else None,
-                            sol.s[i] if have_duals else None))
-            if plan["pair_slices"]:
-                with self.obs.span("build.wait_pairs"):
-                    if full_out:
-                        V, conv, grad, u0, z, lam_p, s_p = \
-                            self._wait_or_fallback(
-                                "pairs_full", ph,
-                                (plan["pair_t"], plan["pair_d"],
-                                 plan.get("pair_warm")))
-                    else:
-                        V, conv, grad, u0, z = self._wait_or_fallback(
-                            "pairs", ph, (plan["pair_t"], plan["pair_d"]))
-                        lam_p = s_p = None
-                nt, nu, nz = (self.problem.n_theta, self.problem.n_u,
-                              self.oracle.can.nz)
-                have_duals = lam_p is not None
-                for k, ds, lo in plan["pair_slices"]:
-                    row = self.cache.get_key(k)
-                    if row is None:
-                        Vr = np.full(nd, np.inf)
-                        convr = np.zeros(nd, dtype=bool)
-                        gradr = np.zeros((nd, nt))
-                        u0r = np.zeros((nd, nu))
-                        zr = np.zeros((nd, nz))
-                        maskr = np.zeros(nd, dtype=bool)
-                        lamr = np.zeros((nd, nc)) if have_duals else None
-                        sr = np.zeros((nd, nc)) if have_duals else None
-                    else:
-                        Vr, convr, gradr = (row[0].copy(), row[1].copy(),
-                                            row[2].copy())
-                        u0r, zr = row[3].copy(), row[4].copy()
-                        maskr = row[7].copy()
-                        lamr = sr = None
-                        if have_duals:
-                            lamr = (row[8].copy() if row[8] is not None
-                                    else np.zeros((nd, nc)))
-                            sr = (row[9].copy() if row[9] is not None
-                                  else np.zeros((nd, nc)))
-                    sl = slice(lo, lo + ds.size)
-                    Vr[ds], convr[ds], gradr[ds] = V[sl], conv[sl], grad[sl]
-                    u0r[ds], zr[ds] = u0[sl], z[sl]
+        nc = self.oracle.can.nc
+        if plan["grid_arr"] is not None:
+            have_duals = sol.lam is not None
+            for i, k in enumerate(plan["grid_keys"]):
+                self.cache.put_key(
+                    k, (sol.V[i], sol.conv[i], sol.grad[i], sol.u0[i],
+                        sol.z[i], sol.Vstar[i], sol.dstar[i], full,
+                        sol.lam[i] if have_duals else None,
+                        sol.s[i] if have_duals else None))
+        if plan["pair_slices"]:
+            V, conv, grad, u0, z, lam_p, s_p = pair_out
+            nt, nu, nz = (self.problem.n_theta, self.problem.n_u,
+                          self.oracle.can.nz)
+            have_duals = lam_p is not None
+            for k, ds, lo in plan["pair_slices"]:
+                row = self.cache.get_key(k)
+                if row is None:
+                    Vr = np.full(nd, np.inf)
+                    convr = np.zeros(nd, dtype=bool)
+                    gradr = np.zeros((nd, nt))
+                    u0r = np.zeros((nd, nu))
+                    zr = np.zeros((nd, nz))
+                    maskr = np.zeros(nd, dtype=bool)
+                    lamr = np.zeros((nd, nc)) if have_duals else None
+                    sr = np.zeros((nd, nc)) if have_duals else None
+                else:
+                    Vr, convr, gradr = (row[0].copy(), row[1].copy(),
+                                        row[2].copy())
+                    u0r, zr = row[3].copy(), row[4].copy()
+                    maskr = row[7].copy()
+                    lamr = sr = None
                     if have_duals:
-                        lamr[ds] = lam_p[sl]
-                        sr[ds] = s_p[sl]
-                    maskr[ds] = True
-                    # Same reduction as oracle.reduce_deltas (first
-                    # minimum): skipped cells are +inf/unconverged, so the
-                    # subset argmin equals the full-grid argmin.
-                    Vval = np.where(convr, Vr, np.inf)
-                    j = int(np.argmin(Vval))
-                    Vs = Vval[j]
-                    self.cache.put_key(k, (Vr, convr, gradr, u0r, zr, Vs,
-                                           np.int64(j if np.isfinite(Vs)
-                                                    else -1),
-                                           full if maskr.all() else maskr,
-                                           lamr, sr))
-        finally:
-            self._oracle_s += time.perf_counter() - t0
+                        lamr = (row[8].copy() if row[8] is not None
+                                else np.zeros((nd, nc)))
+                        sr = (row[9].copy() if row[9] is not None
+                              else np.zeros((nd, nc)))
+                sl = slice(lo, lo + ds.size)
+                Vr[ds], convr[ds], gradr[ds] = V[sl], conv[sl], grad[sl]
+                u0r[ds], zr[ds] = u0[sl], z[sl]
+                if have_duals:
+                    lamr[ds] = lam_p[sl]
+                    sr[ds] = s_p[sl]
+                maskr[ds] = True
+                # Same reduction as oracle.reduce_deltas (first
+                # minimum): skipped cells are +inf/unconverged, so the
+                # subset argmin equals the full-grid argmin.
+                Vval = np.where(convr, Vr, np.inf)
+                j = int(np.argmin(Vval))
+                Vs = Vval[j]
+                self.cache.put_key(k, (Vr, convr, gradr, u0r, zr, Vs,
+                                       np.int64(j if np.isfinite(Vs)
+                                                else -1),
+                                       full if maskr.all() else maskr,
+                                       lamr, sr))
 
     def _wait_or_fallback(self, kind: str, handle, args: tuple):
         """Resolve one dispatched part; on device failure re-solve the
@@ -818,38 +923,33 @@ class FrontierEngine:
         self._oracle_s = 0.0
         B = min(len(self.frontier), self.cfg.batch_simplices)
         nodes = [self.frontier.popleft() for _ in range(B)]
-        pf = self._prefetch
-        self._prefetch = None
-        if pf is not None and pf[0] == tuple(nodes):
-            # This batch's point solves were dispatched DURING the
-            # previous step (before its consume), so the device worked
-            # through them while the host was waiting + certifying.
-            plan, gh, ph = pf[1], pf[2], pf[3]
-            self.n_prefetched_steps += 1
-        else:
-            plan = self._plan_missing(nodes)
-            gh, ph = self._dispatch_plan(plan)
-        # Prefetch the NEXT batch before blocking on this one.  Children
-        # append to the BACK of the deque, so whenever the remaining
-        # frontier already holds a full batch, the next batch is exactly
-        # its current prefix -- known now, before this step's splits.
-        # Planning against the pre-consume cache can re-solve a midpoint
-        # shared across the batch boundary (rare); the consume-time merge
-        # makes that a duplicate identical solve, not an inconsistency.
-        # Stage-2 solves queue behind the prefetched points on the
-        # device; latency moves around but the device never idles
-        # during host-side certification -- the throughput win.
-        if (getattr(self.cfg, "prefetch_solves", True)
-                and len(self.frontier) >= self.cfg.batch_simplices):
-            import itertools
-
-            nxt = list(itertools.islice(self.frontier, 0,
-                                        self.cfg.batch_simplices))
-            plan2 = self._plan_missing(nxt)
-            if plan2 is not None:
-                gh2, ph2 = self._dispatch_plan(plan2)
-                self._prefetch = (tuple(nxt), plan2, gh2, ph2)
-        self._consume_plan(plan, gh, ph)
+        pipe = self._pipe
+        # Was this batch planned + dispatched during an earlier step?
+        # (Claims are full-batch frontier prefixes, so a head claim is
+        # always exactly this batch; the device worked through its point
+        # solves while the host certified previous steps.)
+        pipe.pop_claim(nodes)
+        # Refill the lookahead BEFORE blocking on this batch: up to
+        # cfg.pipeline_depth future batches are tentatively planned and
+        # dispatched, so stage-2 solves queue behind them on the device
+        # and the device never idles during host-side certification.
+        with self.obs.span("build.pipeline_fill"):
+            pipe.fill()
+        # Authoritative plan, computed against exactly the cache state
+        # the synchronous build would see at this step; the pipeline
+        # serves route-matched cells from the in-flight window (one
+        # coalesced solve fanned out to every requester) and re-solves
+        # the rest synchronously, then the shared merge writes the
+        # rows -- node-for-node identical to the synchronous build
+        # (partition/pipeline.py, correctness model).
+        plan = self._plan_missing(nodes)
+        if plan is not None:
+            sol, pair_out = pipe.serve(plan)
+            self._merge_plan_results(plan, sol, pair_out)
+        # Speculative child dispatch: cells the inherited-gap heuristic
+        # predicts will split get their children's shared midpoint
+        # dispatched NOW, before this batch's certificates run.
+        pipe.speculate(nodes)
 
         results: dict[int, certify.CertificateResult] = {}
         stage2: list[tuple[int, int]] = []  # (node, delta')
@@ -994,6 +1094,7 @@ class FrontierEngine:
         store_z = getattr(self.cfg, "store_vertex_z", True)
         for n in nodes:
             res = results[n]
+            did_split = False
             if res.status == "certified":
                 self.tree.set_leaf(n, LeafData(
                     delta_idx=res.delta_idx,
@@ -1029,6 +1130,7 @@ class FrontierEngine:
                         n_leaves += 1
                         self._inherit.pop(n, None)
                         self._release(n)
+                        pipe.on_commit(n, split=False)
                         continue
                 if self.tree.depth[n] >= self.cfg.max_depth:
                     # Depth cap: accept the best available candidate as an
@@ -1049,9 +1151,14 @@ class FrontierEngine:
                             certified=False))
                     self._inherit.pop(n, None)
                     self._release(n)
+                    pipe.on_commit(n, split=False)
                     continue
                 left, right, i, j, _ = geometry.bisect(self.tree.vertices[n])
                 li, ri = self.tree.split(n, left, right, (i, j))
+                did_split = True
+                # The children inherit the parent's certificate gap as
+                # their split-prediction hint (speculative dispatch).
+                pipe.note_children(li, ri, float(res.gap))
                 self.frontier.append(li)
                 self.frontier.append(ri)
                 # Children first: shared parent/child vertices must never
@@ -1072,6 +1179,10 @@ class FrontierEngine:
                 n_splits += 1
             self._inherit.pop(n, None)
             self._release(n)
+            # Settle this cell's speculation: a non-split drops its
+            # staged child-midpoint rows before they can reach the
+            # cache (mis-speculation = waste, never a changed tree).
+            pipe.on_commit(n, split=did_split)
 
         self.steps += 1
         step_s = time.perf_counter() - t_step
@@ -1080,6 +1191,8 @@ class FrontierEngine:
         # -- the JSONL device-utilization proxy (SURVEY.md section 6.5;
         # exact per-op device time lives in the --profile trace).
         device_frac = round(self._oracle_s / max(step_s, 1e-9), 3)
+        self.device_frac_ema = (0.7 * self.device_frac_ema
+                                + 0.3 * device_frac)
         self.log.emit(step=self.steps, frontier=len(self.frontier),
                       batch=B, leaves=n_leaves, splits=n_splits,
                       regions=regions,
@@ -1112,11 +1225,24 @@ class FrontierEngine:
                 (regions - self._obs_regions0) / max(wall, 1e-9))
             m.histogram("build.step_s").observe(step_s)
             m.histogram("build.oracle_wait_s").observe(self._oracle_s)
+            # Pipeline occupancy + speculation/dedup economy: cumulative
+            # gauges, cheap to recompute per step; scripts/obs_report.py
+            # renders them next to device_frac (the device-busy vs
+            # host-busy occupancy split).
+            m.gauge("build.pipeline_fill").set(
+                pipe.planned_in_flight / pipe.depth if pipe.depth
+                else 0.0)
+            m.gauge("build.pipeline_fill_frac").set(pipe.fill_frac())
+            m.gauge("build.dedup_saved").set(pipe.dedup_saved)
+            m.gauge("build.spec_hit_rate").set(pipe.spec_hit_rate())
+            m.gauge("build.spec_waste_frac").set(
+                pipe.spec_waste_frac(self.oracle.n_point_solves))
             rec = o.event("build.step", step=self.steps, regions=regions,
                           frontier=len(self.frontier), batch=B,
                           leaves=n_leaves, splits=n_splits,
                           step_s=round(step_s, 6),
-                          device_frac=device_frac)
+                          device_frac=device_frac,
+                          pipeline=pipe.in_flight)
             if self._health is not None:
                 # In-stream watchdog (cfg.health_rules): rolling rules
                 # over the step events, plus a periodic metrics
@@ -1169,6 +1295,12 @@ class FrontierEngine:
                     import jax
 
                     jax.profiler.stop_trace()
+            # Drop whatever the lookahead still has in flight (budget or
+            # max_steps stop): the claims were never popped from the
+            # frontier, so truncation stats stay exact, and unwaited
+            # speculation settles into the waste counters before the
+            # stats snapshot below.
+            self._pipe.cancel()
             wall = time.perf_counter() - t0
             stats = self.stats_dict(wall)
             self.log.emit(done=True, **stats)
@@ -1229,9 +1361,33 @@ class FrontierEngine:
             # commutation was Farkas-excluded on an ancestor simplex
             # (cfg.mask_point_solves).
             "masked_point_skips": self.n_point_skips,
-            # Steps whose point solves were dispatched during the
-            # previous step's host work (cfg.prefetch_solves).
-            "prefetched_steps": self.n_prefetched_steps,
+            # Steps whose point solves were dispatched during an
+            # EARLIER step's host work (the bounded build pipeline;
+            # the legacy key name is kept for BENCH/driver consumers).
+            "prefetched_steps": self._pipe.n_pipelined_steps,
+            "pipelined_steps": self._pipe.n_pipelined_steps,
+            "pipeline_depth": self._pipe.depth,
+            # Mean lookahead occupancy (in-flight claims / depth); 1.0
+            # = the pipeline stayed full every step.
+            "pipeline_fill_frac": round(self._pipe.fill_frac(), 4),
+            # (vertex, delta) device solves avoided by coalescing
+            # duplicate in-flight requests across the window (the old
+            # prefetch re-solved these across batch boundaries).
+            # Counted at fill time, once per skipped re-dispatch; a
+            # serve-time route miss on a counted cell (donor drift,
+            # rare) re-solves it anyway, so the figure can overstate by
+            # those cells.
+            "dedup_saved": self._pipe.dedup_saved,
+            # Speculative child dispatch economy: consumed vs dropped
+            # speculative point-QP cells, the derived precision, and
+            # the waste as a fraction of all point-QP cells the device
+            # ran (waited solves + dropped-unwaited speculation).
+            "spec_hits": self._pipe.spec_hits,
+            "spec_waste": self._pipe.spec_waste,
+            "spec_hit_rate": round(self._pipe.spec_hit_rate(), 4),
+            "spec_waste_frac": round(
+                self._pipe.spec_waste_frac(self.oracle.n_point_solves),
+                4),
             "device_failures": self.n_device_failures,
             "cache_peak_vertices": self.cache.peak_vertices,
             "cache_peak_mb": round(self.cache.peak_bytes / 2**20, 2),
@@ -1242,6 +1398,20 @@ class FrontierEngine:
     # -- checkpoint / resume (SURVEY.md section 6.4) -----------------------
 
     def save_checkpoint(self, path: str) -> None:
+        # Cancel the in-flight pipeline BEFORE serializing (and before
+        # the owner check -- under SPMD every process must cancel
+        # identically to stay in lockstep): a snapshot is only ever
+        # taken at a quiescent boundary, so a resume can never
+        # re-dispatch or double-commit work that was in flight at
+        # checkpoint time.  (The old single-slot prefetch serialized
+        # with a handle armed and the resume path silently discarded
+        # it.)  Claims were never popped from the frontier, so the
+        # snapshot loses no nodes; dropped handles were never counted
+        # by the oracle, so resumed-equals-straight solve parity holds.
+        # Cost: one lookahead's dispatched device work per
+        # checkpoint_every steps (~0.1% at long_build's default 1000)
+        # -- accepted for the hard quiescence invariant.
+        self._pipe.cancel()
         # Under multi-process SPMD every process runs the frontier in
         # lockstep; side effects belong to the owner (process 0) only.
         from explicit_hybrid_mpc_tpu.parallel import distributed
@@ -1317,9 +1487,14 @@ class FrontierEngine:
         eng._inherit = dict(snap.get("inherit", {}))
         eng.n_inherited_skips = snap.get("n_inherited_skips", 0)
         eng.n_point_skips = snap.get("n_point_skips", 0)
-        eng.n_prefetched_steps = 0
-        eng._prefetch = None
         eng._full_mask = np.ones(oracle.can.n_delta, dtype=bool)
+        # Fresh pipeline: in-flight state is never serialized (the
+        # checkpoint cancelled it), so a resumed build starts quiescent
+        # and re-plans from the restored frontier.  Pre-pipeline
+        # snapshots resolve the new cfg knobs through the dataclass's
+        # class-level defaults -- safe, because pipelining/speculation
+        # are bit-invisible to the produced tree by construction.
+        eng._pipe = BuildPipeline(eng)
         # Cache rows from pre-masking checkpoints lack the solved-delta
         # mask (8th element): every cell in them was actually solved.
         # Rows from pre-warm-start checkpoints lack the duals/slacks
@@ -1353,6 +1528,7 @@ class FrontierEngine:
         # drop cache rows no open simplex references (the snapshot may
         # predate their eviction).
         eng._refcount = collections.Counter()
+        eng.device_frac_ema = 0.0
         # node -> vertex cache keys memo (see _keys): populated here for
         # the restored open set, dropped per node in _release.
         eng._node_keys = {}
@@ -1383,6 +1559,10 @@ def make_oracle(problem, cfg: PartitionConfig, mesh=None,
               # configs carry the dataclass defaults (True).
               two_phase=getattr(cfg, "ipm_two_phase", False),
               phase1_iters=getattr(cfg, "ipm_phase1_iters", None),
+              phase1_iters_point=getattr(cfg, "ipm_phase1_iters_point",
+                                         None),
+              phase1_iters_simplex=getattr(cfg, "ipm_phase1_iters_simplex",
+                                           None),
               warm_start=getattr(cfg, "warm_start_tree", False))
     if getattr(cfg, "prune_rows", False):
         if cfg.backend == "serial" or mesh is not None:

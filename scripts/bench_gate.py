@@ -23,6 +23,8 @@ trajectory first-class:
    large_l_sharded_us_per_query   lower     0.15
    wasted_iter_frac               higher    0.15
    warmstart_accept_rate          higher    0.15
+   pipeline_fill_frac             higher    0.15
+   spec_waste_frac                lower     0.15
    =============================  ========  ===========================
 
    Exit 1 with a human-readable diff when any metric regresses beyond
@@ -46,15 +48,29 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 HISTORY = os.path.join(REPO, "BENCH_HISTORY.jsonl")
 
-#: metric name -> (direction, default relative tolerance).  Direction
-#: "higher" = bigger is better (a drop regresses); "lower" = smaller is
-#: better (a rise regresses).
-GATED_METRICS: dict[str, tuple[str, float]] = {
+#: metric name -> (direction, default relative tolerance[, absolute
+#: slack]).  Direction "higher" = bigger is better (a drop regresses);
+#: "lower" = smaller is better (a rise regresses).  The optional third
+#: element is an ABSOLUTE slack added on top of the relative band:
+#: near-zero ratio metrics (spec_waste_frac ~0.004) would otherwise
+#: fail CI on noise-level absolute changes, since a purely relative
+#: tolerance shrinks with the reference.
+GATED_METRICS: dict[str, tuple] = {
     "value": ("higher", 0.10),
     "online_us_per_query": ("lower", 0.15),
     "large_l_sharded_us_per_query": ("lower", 0.15),
     "wasted_iter_frac": ("higher", 0.15),
     "warmstart_accept_rate": ("higher", 0.15),
+    # Build-pipeline economy (partition/pipeline.py): a run whose
+    # lookahead stops filling serializes host and device again, and a
+    # run whose speculation waste grows burns device work on dropped
+    # mis-speculations -- both are doing worse per region even when
+    # wall-clock noise hides it.  (The all-zero-history filter in
+    # gate() keeps pre-pipeline rows from vacuously gating these;
+    # speculation volume is timing-gated, so its waste gets the
+    # absolute slack.)
+    "pipeline_fill_frac": ("higher", 0.15),
+    "spec_waste_frac": ("lower", 0.15, 0.02),
 }
 
 _ROW_EXTRAS = ("regions", "unit", "precision", "truncated",
@@ -186,25 +202,45 @@ def gate(candidate: dict, history: list[dict], tol: dict | None = None,
                     f"{candidate.get('platform')!r}): gate vacuously "
                     "passes -- run with --update to start the history")
         return flags, info
-    for metric, (direction, default_tol) in GATED_METRICS.items():
+    for metric, spec in GATED_METRICS.items():
+        direction, default_tol = spec[0], spec[1]
+        abs_slack = spec[2] if len(spec) > 2 else 0.0
         cand = candidate.get(metric)
         if cand is None:
             continue
         vals = [r[metric] for r in base[-window:]
                 if isinstance(r.get(metric), (int, float))]
         # All-zero history (e.g. wasted_iter_frac before two-phase
-        # existed) carries no regression information.
-        vals = [v for v in vals if v != 0]
+        # existed) carries no regression information for purely
+        # RELATIVE metrics.  Metrics with an absolute slack keep their
+        # zeros: 0 is the healthy steady state for a near-zero ratio
+        # (spec_waste_frac on a platform whose speculation stays
+        # dormant), and dropping those rows would leave the metric
+        # ungated forever on exactly the platform that must catch a
+        # blow-up.
+        if len(spec) <= 2:
+            vals = [v for v in vals if v != 0]
         if not vals:
             continue
         ref = sum(vals) / len(vals)
         t = tol.get(metric, default_tol)
-        delta = cand / ref - 1  # signed relative change vs the window
-        regressed = (delta < -t) if direction == "higher" else (delta > t)
-        verb = "higher" if delta >= 0 else "lower"
-        line = (f"{metric}: {cand:.4g} vs trailing-{len(vals)} mean "
-                f"{ref:.4g} ({100 * abs(delta):.1f}% {verb}, "
-                f"tol {100 * t:.0f}%)")
+        if ref == 0:
+            # Relative change vs a zero reference is undefined: gate
+            # on the absolute slack alone.
+            regressed = (cand > abs_slack) if direction == "lower" \
+                else (cand < -abs_slack)
+            line = (f"{metric}: {cand:.4g} vs trailing-{len(vals)} "
+                    f"mean 0 (abs slack {abs_slack:g})")
+        else:
+            delta = cand / ref - 1  # signed relative change vs window
+            if direction == "higher":
+                regressed = delta < -t and cand < ref - abs_slack
+            else:
+                regressed = delta > t and cand > ref + abs_slack
+            verb = "higher" if delta >= 0 else "lower"
+            line = (f"{metric}: {cand:.4g} vs trailing-{len(vals)} "
+                    f"mean {ref:.4g} ({100 * abs(delta):.1f}% {verb}, "
+                    f"tol {100 * t:.0f}%)")
         if regressed:
             flags.append("REGRESSION " + line)
         else:

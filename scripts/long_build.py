@@ -24,7 +24,11 @@ drain), LONG_BUDGET_S (default 21000), LONG_PROBLEM (default
 inverted_pendulum), LONG_PROBLEM_ARGS (JSON dict), LONG_OUT, LONG_CKPT,
 LONG_CKPT_EVERY (steps, default 1000), LONG_BATCH, LONG_MAX_DEPTH
 (default 64), LONG_BOUNDARY_DEPTH (semi-explicit closure depth, default
-off), LONG_PRECISION (default bench.default_precision).
+off), LONG_PRECISION (default bench.default_precision),
+LONG_PIPELINE_DEPTH / LONG_SPECULATE / LONG_DEDUP_WINDOW (build
+pipeline: lookahead batches, speculative child dispatch, cross-batch
+vertex-dedup window -- partition/pipeline.py; bit-invisible to the
+produced tree).
 
 Diagnostics (ISSUE 4): LONG_RECORDER (default 1 -- flight-recorder
 repro bundles under <artifact dir>/repro on solver anomalies;
@@ -123,6 +127,17 @@ def run(result: dict, out_path: str) -> None:
         # satellite leaves in RAM and per checkpoint); they feed offline
         # soundness sampling, not the deployed controller.
         store_vertex_z=os.environ.get("LONG_STORE_Z", "1") != "0",
+        # Build pipeline (partition/pipeline.py): LONG_PIPELINE_DEPTH
+        # (lookahead batches; 0 = synchronous), LONG_SPECULATE=0/1,
+        # LONG_DEDUP_WINDOW.  Bit-invisible to the produced tree, so a
+        # campaign can be resumed under different settings; defaults =
+        # the shipping PartitionConfig defaults.
+        **({"pipeline_depth":
+            int(os.environ["LONG_PIPELINE_DEPTH"])}
+           if os.environ.get("LONG_PIPELINE_DEPTH") else {}),
+        speculate=os.environ.get("LONG_SPECULATE", "1") != "0",
+        **({"dedup_window": int(os.environ["LONG_DEDUP_WINDOW"])}
+           if os.environ.get("LONG_DEDUP_WINDOW") else {}),
         # Flight recorder: a multi-hour campaign is exactly where an
         # unreproducible anomaly hurts most; bundles land next to the
         # artifact.  recorder_dir must stay None when disabled -- a

@@ -77,6 +77,12 @@ def report(records: list[dict]) -> dict:
             "device_frac_mean": (sum(dfrac) / len(dfrac)
                                  if dfrac else None),
         }
+        # Pipeline occupancy trend off the per-step events (the
+        # cumulative figures come from the gauges below).
+        fills = [r["pipeline"] for r in steps if "pipeline" in r]
+        if fills:
+            out["build"]["pipeline_inflight_mean"] = (sum(fills)
+                                                      / len(fills))
     done = [r for r in records
             if r.get("kind") == "event" and r.get("name") == "build.done"]
     if done:
@@ -114,6 +120,21 @@ def report(records: list[dict]) -> dict:
                       "warmstart_accept_rate", "compiled_shapes"):
                 if f"oracle.{g}" in out["gauges"]:
                     out["oracle"][g] = out["gauges"][f"oracle.{g}"]
+        # Build-pipeline occupancy + speculation/dedup economy gauges
+        # (partition/pipeline.py).  device_frac is the device-busy
+        # fraction of each step; its complement is host-busy -- the
+        # occupancy split the pipeline exists to overlap.
+        pipe = {g: out["gauges"][f"build.{g}"]
+                for g in ("pipeline_fill", "pipeline_fill_frac",
+                          "dedup_saved", "spec_hit_rate",
+                          "spec_waste_frac")
+                if f"build.{g}" in out["gauges"]}
+        if pipe:
+            dfm = out.get("build", {}).get("device_frac_mean")
+            if dfm is not None:
+                pipe["device_busy_frac"] = dfm
+                pipe["host_busy_frac"] = max(0.0, 1.0 - dfm)
+            out["pipeline"] = pipe
         shards = {}
         for k, v in out["histograms"].items():
             if k.startswith(_SHARD_PREFIX) and k.endswith(".query_s"):
@@ -219,6 +240,26 @@ def diff_bench(rep: dict, bench: dict, tol: float = 0.10) -> list[str]:
             flags.append(
                 f"{label} regression: {rval:.3f} vs bench {bval_f:.3f} "
                 f"({100 * (1 - rval / bval_f):.0f}% lower)")
+    # Pipeline-economy regressions (ISSUE 7), same directional logic: a
+    # lookahead that stops filling re-serializes host and device; a
+    # speculation hit-rate collapse or waste growth burns device work
+    # on dropped mis-speculations.
+    pipe = rep.get("pipeline", {})
+    for field, label in (("pipeline_fill_frac", "pipeline fill"),
+                         ("spec_hit_rate", "speculation hit rate")):
+        bval_f = bench.get(field)
+        rval = pipe.get(field)
+        if bval_f and rval is not None and rval < (1 - tol) * bval_f:
+            flags.append(
+                f"{label} regression: {rval:.3f} vs bench {bval_f:.3f} "
+                f"({100 * (1 - rval / bval_f):.0f}% lower)")
+    b_waste = bench.get("spec_waste_frac")
+    r_waste = pipe.get("spec_waste_frac")
+    if r_waste is not None and b_waste is not None \
+            and r_waste > b_waste + tol * max(b_waste, 0.05):
+        flags.append(
+            f"speculation waste regression: {r_waste:.3f} vs bench "
+            f"{b_waste:.3f}")
     # Serving headline: sharded us/query against the bench's large-L
     # figure, when both sides measured it.
     b_us = bench.get("large_l_sharded_us_per_query")
@@ -295,6 +336,17 @@ def render_text(rep: dict, flags: list[str], bench_path: str | None) -> str:
                 f"warm-start accept "
                 f"{orc.get('warmstart_accept_rate', 0.0):.3f}, "
                 f"{int(orc.get('compiled_shapes', 0))} compiled shapes")
+    pipe = rep.get("pipeline")
+    if pipe:
+        occ = ""
+        if pipe.get("device_busy_frac") is not None:
+            occ = (f", occupancy device {pipe['device_busy_frac']:.2f} /"
+                   f" host {pipe['host_busy_frac']:.2f}")
+        ln.append(
+            f"pipeline: fill {pipe.get('pipeline_fill_frac', 0.0):.2f}"
+            f", spec hit rate {pipe.get('spec_hit_rate', 0.0):.2f}"
+            f", spec waste {pipe.get('spec_waste_frac', 0.0):.3f}"
+            f", dedup saved {int(pipe.get('dedup_saved', 0))}" + occ)
     srv = rep.get("serve")
     if srv:
         ln.append(f"serve: {srv.get('queries')} queries "

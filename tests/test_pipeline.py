@@ -1,0 +1,343 @@
+"""Bounded async build pipeline (partition/pipeline.py): bit-parity at
+depth >= 2 with speculation + dedup, cross-batch solve coalescing,
+checkpoint/resume quiescence, mesh parity, and the new config/oracle
+knobs."""
+
+import collections
+import os
+
+import numpy as np
+import pytest
+
+from explicit_hybrid_mpc_tpu.config import PartitionConfig
+from explicit_hybrid_mpc_tpu.oracle.oracle import Oracle
+from explicit_hybrid_mpc_tpu.partition import geometry
+from explicit_hybrid_mpc_tpu.partition.frontier import (FrontierEngine,
+                                                        build_partition)
+from explicit_hybrid_mpc_tpu.problems.registry import make
+
+EPS = 0.35
+
+
+def _tree_signature(res):
+    """Node-for-node structural identity: every node's vertex matrix
+    (bitwise -- bisection arithmetic is exact), every leaf's chosen
+    commutation and certification status, and the region/node counts.
+    Leaf PAYLOAD floats are deliberately excluded: a solve served from
+    a different pow-2 device bucket is a different XLA executable and
+    may differ in the final ulp (the same caveat the legacy prefetch
+    and the warm-start donors carry); the parity contract is the tree,
+    not the last bit of V."""
+    tree = res.tree
+    leaves = tree.converged_leaves()
+    return (res.stats["regions"], res.stats["tree_nodes"],
+            res.stats["uncertified"], res.stats["semi_explicit"],
+            tuple(tree.vertices[n].tobytes() for n in range(len(tree))),
+            tuple(tree.leaf_data[n].delta_idx for n in leaves),
+            tuple(bool(tree.leaf_data[n].certified) for n in leaves))
+
+
+def _build(prob, name, **kw):
+    cfg = PartitionConfig(problem=name, eps_a=kw.pop("eps_a", EPS),
+                          backend="cpu",
+                          batch_simplices=kw.pop("batch_simplices", 16),
+                          max_depth=kw.pop("max_depth", 20), **kw)
+    return build_partition(prob, cfg, Oracle(prob, backend="cpu"))
+
+
+def test_pipeline_bit_parity_with_speculation():
+    """Acceptance: pipeline_depth >= 2 + speculation + dedup produce a
+    BIT-IDENTICAL tree (same region count, node-for-node vertices and
+    leaf payloads) vs the synchronous reference."""
+    prob = make("double_integrator", N=3, theta_box=1.5)
+    ref = _build(prob, "double_integrator", prefetch_solves=False)
+    pipe = _build(prob, "double_integrator", pipeline_depth=3,
+                  speculate=True)
+    assert _tree_signature(ref) == _tree_signature(pipe)
+    assert pipe.stats["pipelined_steps"] > 0
+    assert pipe.stats["pipeline_fill_frac"] > 0
+
+
+def test_pipeline_bit_parity_hybrid_warm(monkeypatch):
+    """Same acceptance on a hybrid problem exercising masked solves,
+    warm-start donors, stage-2 programs, and speculation on the
+    mixed-feasibility boundary.  The idle-device gate is lifted so
+    speculation actually dispatches on this always-busy CPU host."""
+    from explicit_hybrid_mpc_tpu.partition.pipeline import BuildPipeline
+
+    monkeypatch.setattr(BuildPipeline, "SPEC_DEVICE_FRAC_MAX", 2.0)
+    prob = make("inverted_pendulum", N=3)
+    out = {}
+    for key, kw in (("sync", dict(prefetch_solves=False)),
+                    ("pipe", dict(pipeline_depth=3, speculate=True))):
+        cfg = PartitionConfig(problem="inverted_pendulum", eps_a=0.5,
+                              backend="cpu", batch_simplices=64,
+                              max_depth=12, **kw)
+        out[key] = build_partition(prob, cfg,
+                                   Oracle(prob, backend="cpu"))
+    assert _tree_signature(out["sync"]) == _tree_signature(out["pipe"])
+    s = out["pipe"].stats
+    # Speculation actually fired on the mode-boundary cells and its
+    # economy figures are well-formed.
+    assert s["spec_hits"] > 0
+    assert 0.0 <= s["spec_hit_rate"] <= 1.0
+    assert 0.0 <= s["spec_waste_frac"] < 1.0
+    assert s["simplex_solves"] == out["sync"].stats["simplex_solves"]
+
+
+class _SpyOracle(Oracle):
+    """Counts every dispatched-and-waited (vertex, delta) point cell."""
+
+    def __init__(self, *a, **k):
+        super().__init__(*a, **k)
+        self.waited = collections.Counter()
+        self._pend = {}
+
+    def dispatch_vertices(self, thetas):
+        h = super().dispatch_vertices(thetas)
+        keys = [geometry.vertex_key(t) for t in np.atleast_2d(thetas)]
+        self._pend[id(h)] = [(k, d) for k in keys
+                             for d in range(self.can.n_delta)]
+        return h
+
+    def wait_vertices(self, h):
+        for c in self._pend.pop(id(h), ()):
+            self.waited[c] += 1
+        return super().wait_vertices(h)
+
+    def dispatch_pairs(self, thetas, ds, warm=None):
+        h = (super().dispatch_pairs(thetas, ds, warm=warm)
+             if warm is not None else super().dispatch_pairs(thetas, ds))
+        self._pend[id(h)] = [
+            (geometry.vertex_key(t), int(d))
+            for t, d in zip(np.atleast_2d(thetas), np.asarray(ds))]
+        return h
+
+    def wait_pairs_full(self, h):
+        for c in self._pend.pop(id(h), ()):
+            self.waited[c] += 1
+        return super().wait_pairs_full(h)
+
+
+def test_dedup_coalesces_and_fans_out():
+    """Cross-batch dedup: duplicate (vertex, delta) requests across the
+    in-flight window collapse into ONE device solve whose rows serve
+    every requester.  With speculation off the pipelined build must
+    therefore wait each cell exactly as often as the synchronous build
+    does (the old prefetch re-solved batch-boundary midpoints), while
+    producing the identical tree -- i.e. every requester received the
+    coalesced solve's rows."""
+    prob = make("double_integrator", N=3, theta_box=1.5)
+    waited = {}
+    sigs = {}
+    for key, kw in (("sync", dict(prefetch_solves=False)),
+                    ("pipe", dict(pipeline_depth=3, speculate=False))):
+        cfg = PartitionConfig(problem="double_integrator", eps_a=EPS,
+                              backend="cpu", batch_simplices=16,
+                              max_depth=20, **kw)
+        o = _SpyOracle(prob, backend="cpu")
+        res = build_partition(prob, cfg, o)
+        waited[key] = o.waited
+        sigs[key] = _tree_signature(res)
+    assert sigs["sync"] == sigs["pipe"]
+    # Exactly the synchronous multiset of waited cells: nothing solved
+    # twice that the serial build solves once.
+    assert waited["pipe"] == waited["sync"]
+
+
+def test_resume_mid_pipeline():
+    """Checkpointing with claims + speculation in flight must cancel
+    them (quiescent snapshot) so a resumed build re-dispatches nothing
+    already committed and finishes with the identical tree."""
+    prob = make("double_integrator", N=3, theta_box=1.5)
+    ref = _build(prob, "double_integrator", prefetch_solves=False)
+
+    def engine():
+        cfg = PartitionConfig(problem="double_integrator", eps_a=EPS,
+                              backend="cpu", batch_simplices=16,
+                              max_depth=20, pipeline_depth=3,
+                              speculate=True)
+        return FrontierEngine(prob, Oracle(prob, backend="cpu"), cfg)
+
+    eng = engine()
+    for _ in range(6):
+        eng.step()
+    assert eng._pipe.in_flight > 0  # the lookahead is genuinely armed
+    ckpt = os.path.join(os.environ.get("PYTEST_TMP", "/tmp"),
+                        "pipe_resume.pkl")
+    eng.save_checkpoint(ckpt)
+    # The satellite bugfix: a snapshot is only taken at a quiescent
+    # boundary -- nothing in flight survives into (or out of) it.
+    assert eng._pipe.in_flight == 0
+    res_a = eng.run()                       # original finishes
+    eng2 = FrontierEngine.resume(ckpt, prob, Oracle(prob, backend="cpu"))
+    assert eng2._pipe.in_flight == 0
+    res_b = eng2.run()                      # resumed finishes
+    assert _tree_signature(ref) == _tree_signature(res_a)
+    assert _tree_signature(ref) == _tree_signature(res_b)
+    # No re-dispatch of already-committed work: the resumed session's
+    # total solve count equals the straight run's.
+    assert res_b.stats["oracle_solves"] == res_a.stats["oracle_solves"]
+    os.unlink(ckpt)
+
+
+def test_pipeline_parity_under_mesh():
+    """Acceptance: bit-identical trees under the virtual-device mesh
+    too (the mesh path keeps the dense grid route; warm starts and the
+    cohort are forced off there)."""
+    from explicit_hybrid_mpc_tpu.parallel import make_mesh
+
+    prob = make("double_integrator", N=3, theta_box=1.5)
+    out = {}
+    for key, kw in (("sync", dict(prefetch_solves=False)),
+                    ("pipe", dict(pipeline_depth=2, speculate=True))):
+        cfg = PartitionConfig(problem="double_integrator", eps_a=EPS,
+                              backend="cpu", batch_simplices=16,
+                              max_depth=16, **kw)
+        oracle = Oracle(prob, backend="cpu", mesh=make_mesh((8, 1)))
+        out[key] = build_partition(prob, cfg, oracle)
+    assert _tree_signature(out["sync"]) == _tree_signature(out["pipe"])
+
+
+def test_pipeline_obs_metrics_schema():
+    """The new pipeline gauges land in the metrics snapshot with the
+    documented names (scripts/obs_report.py and the bench read them)."""
+    from explicit_hybrid_mpc_tpu import obs as obs_lib
+
+    prob = make("double_integrator", N=3, theta_box=1.5)
+    cfg = PartitionConfig(problem="double_integrator", eps_a=EPS,
+                          backend="cpu", batch_simplices=16,
+                          max_depth=16, pipeline_depth=2, obs="jsonl")
+    handle = obs_lib.Obs("jsonl")
+    build_partition(prob, cfg, Oracle(prob, backend="cpu"), obs=handle)
+    gauges = handle.metrics.snapshot()["gauges"]
+    for name in ("build.pipeline_fill", "build.pipeline_fill_frac",
+                 "build.dedup_saved", "build.spec_hit_rate",
+                 "build.spec_waste_frac"):
+        assert name in gauges, name
+    assert 0.0 <= gauges["build.pipeline_fill_frac"] <= 1.0
+    assert 0.0 <= gauges["build.spec_waste_frac"] <= 1.0
+
+
+def test_obs_report_pipeline_block():
+    """scripts/obs_report.py renders the pipeline occupancy block from
+    a stream's gauges and diff-flags pipeline-economy regressions
+    against a bench JSON (like the existing wasted_iter_frac flags)."""
+    import importlib
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, os.path.join(repo, "scripts"))
+    try:
+        obs_report = importlib.import_module("obs_report")
+    finally:
+        sys.path.pop(0)
+    records = [
+        {"kind": "event", "name": "build.step", "t": 1.0, "step": 1,
+         "regions": 10, "device_frac": 0.4, "pipeline": 2},
+        {"kind": "metrics", "counters": {},
+         "gauges": {"build.pipeline_fill": 1.0,
+                    "build.pipeline_fill_frac": 0.4,
+                    "build.dedup_saved": 12.0,
+                    "build.spec_hit_rate": 0.3,
+                    "build.spec_waste_frac": 0.2},
+         "histograms": {}},
+    ]
+    rep = obs_report.report(records)
+    pipe = rep["pipeline"]
+    assert pipe["pipeline_fill_frac"] == 0.4
+    assert pipe["dedup_saved"] == 12.0
+    assert pipe["device_busy_frac"] == 0.4
+    assert abs(pipe["host_busy_frac"] - 0.6) < 1e-12
+    text = obs_report.render_text(rep, [], None)
+    assert "pipeline: fill 0.40" in text
+    bench = {"pipeline_fill_frac": 0.668, "spec_hit_rate": 0.58,
+             "spec_waste_frac": 0.004}
+    flags = obs_report.diff_bench(rep, bench, tol=0.10)
+    assert any("pipeline fill" in f for f in flags)
+    assert any("speculation hit rate" in f for f in flags)
+    assert any("speculation waste" in f for f in flags)
+
+
+def test_bench_gate_spec_waste_abs_slack():
+    """spec_waste_frac gates with an ABSOLUTE slack on top of the
+    relative band: speculation volume is timing-gated, so noise-level
+    absolute changes on a near-zero reference must not fail CI, while
+    a real waste blow-up still does."""
+    import importlib
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, os.path.join(repo, "scripts"))
+    try:
+        bench_gate = importlib.import_module("bench_gate")
+    finally:
+        sys.path.pop(0)
+    hist = [{"source": "a.json", "platform": "cpu", "contended": False,
+             "error": None, "spec_waste_frac": 0.004, "value": 300.0}]
+    base = {"source": "b.json", "platform": "cpu", "contended": False,
+            "error": None, "value": 300.0}
+    # +50% relative but only +0.002 absolute: within the slack.
+    flags, _ = bench_gate.gate({**base, "spec_waste_frac": 0.006}, hist)
+    assert not any("spec_waste_frac" in f for f in flags)
+    # A genuine blow-up clears both the relative band and the slack.
+    flags, _ = bench_gate.gate({**base, "spec_waste_frac": 0.16}, hist)
+    assert any("spec_waste_frac" in f for f in flags)
+    # All-zero history (speculation dormant on that platform) must NOT
+    # blind the gate: 0 is the healthy reference for slack-bearing
+    # ratio metrics, and a blow-up past the slack still flags.
+    hist0 = [dict(hist[0], spec_waste_frac=0.0)]
+    flags, _ = bench_gate.gate({**base, "spec_waste_frac": 0.01}, hist0)
+    assert not any("spec_waste_frac" in f for f in flags)
+    flags, _ = bench_gate.gate({**base, "spec_waste_frac": 0.16}, hist0)
+    assert any("spec_waste_frac" in f for f in flags)
+
+
+def test_config_validation():
+    with pytest.raises(ValueError):
+        PartitionConfig(pipeline_depth=-1)
+    with pytest.raises(ValueError):
+        PartitionConfig(dedup_window=0)
+    with pytest.raises(ValueError):
+        PartitionConfig(ipm_phase1_iters_point=0)
+    with pytest.raises(ValueError):
+        PartitionConfig(ipm_phase1_iters_simplex=0)
+    # prefetch_solves=False is the pipeline_depth=0 compat alias.
+    prob = make("double_integrator", N=3, theta_box=1.5)
+    cfg = PartitionConfig(problem="double_integrator", eps_a=EPS,
+                          backend="cpu", prefetch_solves=False,
+                          pipeline_depth=5)
+    eng = FrontierEngine(prob, Oracle(prob, backend="cpu"), cfg)
+    assert eng._pipe.depth == 0
+
+
+def test_per_class_phase1_overrides():
+    """Oracle-level per-class phase-1 splits: each class override wins
+    over the shared phase1_iters, which wins over the auto 2/5 split;
+    the CPU twin mirrors them."""
+    prob = make("inverted_pendulum", N=3)
+    o = Oracle(prob, backend="cpu", two_phase=True, precision="mixed",
+               phase1_iters=3, phase1_iters_point=1,
+               phase1_iters_simplex=2)
+    assert o.point_p1 == 1
+    assert o.simplex_p1 == 2
+    twin = o.cpu_twin(prob)
+    assert twin.point_p1 == o.point_p1
+    assert twin.simplex_p1 == o.simplex_p1
+    # Shared value applies where no class override is given.
+    o2 = Oracle(prob, backend="cpu", two_phase=True, precision="mixed",
+                phase1_iters=3, phase1_iters_point=1)
+    assert o2.point_p1 == 1
+    assert o2.simplex_p1 == min(3, o2.n_iter)
+    with pytest.raises(ValueError):
+        Oracle(prob, backend="cpu", phase1_iters_point=0)
+    # Per-class knobs flow from the config through make_oracle.
+    from explicit_hybrid_mpc_tpu.partition.frontier import make_oracle
+
+    cfg = PartitionConfig(problem="inverted_pendulum", backend="cpu",
+                          precision="mixed",
+                          ipm_phase1_iters_point=1,
+                          ipm_phase1_iters_simplex=2)
+    o3 = make_oracle(prob, cfg)
+    assert o3.point_p1 == 1
+    assert o3.simplex_p1 == 2

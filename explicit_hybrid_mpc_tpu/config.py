@@ -11,6 +11,19 @@ from __future__ import annotations
 import dataclasses
 from typing import Optional
 
+#: Largest padding bucket a single evaluator call may mint when the
+#: deploy config leaves max_bucket unset (the ShardedDescent default;
+#: online/sharded.py reads THIS constant so the deploy-time validation
+#: below and the runtime split threshold can never drift).
+DEFAULT_MAX_BUCKET = 1 << 14
+
+
+def is_pow2(n: int) -> bool:
+    """True when `n` is a positive power of two -- the one batching
+    validity check, shared by ServeConfig, the scheduler, and the
+    sharded evaluator so their contracts cannot drift."""
+    return n >= 1 and not (n & (n - 1))
+
 
 @dataclasses.dataclass(frozen=True)
 class PartitionConfig:
@@ -276,3 +289,74 @@ class PartitionConfig:
             from explicit_hybrid_mpc_tpu.obs.health import rules_from_pairs
 
             rules_from_pairs(self.health_rules)
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeConfig:
+    """Configuration for the online serving runtime (serve/).
+
+    Distinct from PartitionConfig on purpose: serving knobs are
+    RUN-scoped (a deploy restarts the server, never the build), and
+    none of them can change a served value -- only latency, batching,
+    and degraded-mode behavior.  Validated eagerly so a bad deploy
+    config dies at startup, not on the first oversized batch.
+    """
+
+    # Controller name in the registry (one scheduler per name).
+    controller: str = "default"
+    # Micro-batch flush threshold (rows).  Must be a power of two:
+    # it is itself the largest scheduler-minted padding bucket, so the
+    # compiled-shape set stays log2-bounded (sharded.py discipline).
+    max_batch: int = 256
+    # Deadline budget: a queued query waits at most this long for the
+    # batch to fill before the scheduler flushes a partial bucket.
+    max_wait_us: float = 2000.0
+    # Largest padding bucket a single evaluator call may mint; larger
+    # submissions are split (online/sharded.py, health.oversized_batch).
+    # None = the evaluator default.
+    max_bucket: Optional[int] = None
+    # Shard count for the descent tables (None = one per local device).
+    n_shards: Optional[int] = None
+    # Degraded-mode policy for not-inside queries (serve/fallback.py):
+    # 'clamp' = clamp-to-certified-box re-evaluation (+ optional
+    # budgeted oracle re-solve when an oracle is provided); 'off' =
+    # return the raw not-inside result untouched.
+    fallback: str = "clamp"
+    # Running budget for host-side oracle re-solves, as a fraction of
+    # all requests seen (0 disables oracle fallback even when an
+    # oracle is available).
+    max_oracle_frac: float = 0.05
+    # Observability mode/path, same semantics as PartitionConfig.obs.
+    obs: str = "off"
+    obs_path: Optional[str] = None
+
+    def __post_init__(self) -> None:
+        if not is_pow2(self.max_batch):
+            raise ValueError(f"max_batch must be a power of two, "
+                             f"got {self.max_batch}")
+        # Validate against the EFFECTIVE bucket: with max_bucket unset
+        # the evaluator still caps at DEFAULT_MAX_BUCKET, and a
+        # max_batch above it would make every full micro-batch split
+        # with a health.oversized_batch warn -- a "validated" deploy
+        # config that permanently alarms.
+        if self.max_bucket is not None and not is_pow2(self.max_bucket):
+            raise ValueError("max_bucket must be a power of two, "
+                             f"got {self.max_bucket}")
+        eff_bucket = (self.max_bucket if self.max_bucket is not None
+                      else DEFAULT_MAX_BUCKET)
+        if eff_bucket < self.max_batch:
+            raise ValueError(
+                f"max_batch {self.max_batch} exceeds the effective "
+                f"evaluator bucket {eff_bucket} (max_bucket"
+                f"{'' if self.max_bucket is not None else ' default'})"
+                ": every full micro-batch would split")
+        if self.max_wait_us <= 0:
+            raise ValueError("max_wait_us must be > 0")
+        if self.fallback not in ("clamp", "off"):
+            raise ValueError(f"unknown fallback mode {self.fallback!r} "
+                             "(expected 'clamp' or 'off')")
+        if not 0.0 <= self.max_oracle_frac <= 1.0:
+            raise ValueError("max_oracle_frac must be in [0, 1]")
+        if self.obs not in ("off", "jsonl", "full"):
+            raise ValueError(f"unknown obs mode {self.obs!r} "
+                             "(expected 'off', 'jsonl', or 'full')")

@@ -12,6 +12,10 @@ empty) -- with the MPI process count replaced by the TPU-native knobs
 Outputs under --output PREFIX: PREFIX.tree.pkl (the simplex tree),
 PREFIX.stats.json (build statistics), PREFIX.log.jsonl (per-step metrics),
 and with --simulate, PREFIX.sim.json (closed-loop comparison).
+
+A second surface, ``python -m explicit_hybrid_mpc_tpu.main serve``,
+deploys exported artifacts behind the online serving runtime
+(serve/cli.py, docs/serving.md).
 """
 
 from __future__ import annotations
@@ -176,6 +180,15 @@ def _parse_problem_args(pairs: list[str]) -> dict:
 
 
 def main(argv: list[str] | None = None) -> int:
+    if argv is None:
+        argv = sys.argv[1:]
+    if argv and argv[0] == "serve":
+        # The online serving runtime is a subcommand, dispatched before
+        # the build parser (whose -e/--example is required): the two
+        # surfaces share nothing but the package.  docs/serving.md.
+        from explicit_hybrid_mpc_tpu.serve.cli import serve_main
+
+        return serve_main(argv[1:])
     args = build_parser().parse_args(argv)
 
     from explicit_hybrid_mpc_tpu.problems.registry import make, names

@@ -38,6 +38,16 @@ Rule schema (all values floats; 0 disables a threshold rule):
                            ``health.host_contended`` (warn)
 ``max_device_failures``    device_failure records tolerated before
                            ``health.device_failures`` (warn)
+``serve_p99_us``           serving p99 latency ceiling in microseconds
+                           (per-controller serve.ctl.<name>.p99_us
+                           gauges, serve/scheduler.py; legacy bare
+                           serve.p99_us also evaluated) ->
+                           ``health.serve_p99_us`` (warn); 0 = off
+                           (the budget is deployment-specific)
+``fallback_frac``          rolling degraded-mode fraction
+                           (serve.ctl.<name>.fallback_frac gauges) ->
+                           ``health.fallback_frac`` (warn) -- the
+                           serving SLO from docs/serving.md
 ``min_solves_for_rates``   rate rules stay silent below this volume
 ``metrics_every_steps``    engine-side feed cadence (frontier.py)
 =========================  =============================================
@@ -70,6 +80,8 @@ DEFAULT_RULES: dict[str, float] = {
     "max_shard_imbalance": 8.0,
     "max_competing_cpu_frac": 0.25,
     "max_device_failures": 3.0,
+    "serve_p99_us": 0.0,
+    "fallback_frac": 0.25,
     "min_solves_for_rates": 2000.0,
     "metrics_every_steps": 100.0,
 }
@@ -124,16 +136,20 @@ class HealthMonitor:
     # -- event plumbing ----------------------------------------------------
 
     def _fire(self, rule: str, severity: str, value, threshold,
-              msg: str) -> Optional[dict]:
+              msg: str, key: Optional[str] = None) -> Optional[dict]:
+        """`key` widens the cooldown identity beyond the rule name
+        (per-controller serving rules: one breaching controller's
+        cooldown must not silence another's first event)."""
+        key = key or rule
         if _SEVERITY[severity] > _SEVERITY[self.worst]:
             self.worst = severity
-        if self._cooldown.get(rule, 0) > 0:
+        if self._cooldown.get(key, 0) > 0:
             # Still cooling down: severity updated, no event.  The
             # cooldown is NOT refreshed here -- a persistent condition
             # must re-notify once per refire_after records, not fall
             # silent for the rest of the episode.
             return None
-        self._cooldown[rule] = self._refire_after
+        self._cooldown[key] = self._refire_after
         ev = {"name": f"health.{rule}", "severity": severity,
               "value": value, "threshold": threshold, "msg": msg}
         self.events.append(ev)
@@ -270,6 +286,45 @@ class HealthMonitor:
             self._fire("shard_imbalance", "warn", round(imb, 3), lim,
                        f"serving shard imbalance {imb:.2f}x max/mean "
                        f"(> {lim:g}): re-shard or deepen the cut")
+
+        # Serving SLO rules, evaluated PER CONTROLLER over the
+        # namespaced serve.ctl.<name>.* gauges (serve/scheduler.py):
+        # several schedulers share one obs handle, and a healthy
+        # controller's gauge must not mask a breaching one.  The
+        # un-namespaced serve.* names from older streams still
+        # evaluate.  Each controller is volume-gated on ITS request
+        # counter like the build-side rate rules -- a three-request
+        # smoke run must not trip a p99 alarm.
+        prefixes = {"serve"}
+        for key in gauges:
+            if key.startswith("serve.ctl.") and (
+                    key.endswith(".p99_us")
+                    or key.endswith(".fallback_frac")):
+                prefixes.add(key.rsplit(".", 1)[0])
+        for pre in sorted(prefixes):
+            ctl = pre[len("serve.ctl."):] if pre != "serve" else ""
+            tag = f" [controller {ctl!r}]" if ctl else ""
+            n_req = counters.get(f"{pre}.requests", 0)
+            lim = self.rules["serve_p99_us"]
+            p99 = gauges.get(f"{pre}.p99_us")
+            if lim > 0 and p99 is not None and n_req >= min_n \
+                    and p99 > lim:
+                self._fire("serve_p99_us", "warn", round(p99, 1), lim,
+                           f"serving p99 {p99:.0f} us over the rolling "
+                           f"window{tag} (> {lim:g} us): deadline "
+                           "budget or shard placement needs retuning",
+                           key=f"serve_p99_us:{ctl}")
+
+            lim = self.rules["fallback_frac"]
+            fb = gauges.get(f"{pre}.fallback_frac")
+            if lim > 0 and fb is not None and n_req >= min_n \
+                    and fb > lim:
+                self._fire("fallback_frac", "warn", round(fb, 4), lim,
+                           f"{100 * fb:.1f}% of recent queries served "
+                           f"degraded{tag} (> {100 * lim:.0f}%): "
+                           "traffic has left the certified box or the "
+                           "tree has holes -- rebuild or widen the "
+                           "partition", key=f"fallback_frac:{ctl}")
 
         lim = self.rules["max_competing_cpu_frac"]
         host = gauges.get("host.competing_cpu_frac_mean")

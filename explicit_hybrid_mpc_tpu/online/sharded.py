@@ -43,6 +43,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from explicit_hybrid_mpc_tpu import config as config_mod
 from explicit_hybrid_mpc_tpu import obs as obs_lib
 from explicit_hybrid_mpc_tpu.online import descent as descent_mod
 from explicit_hybrid_mpc_tpu.online.descent import DescentTable
@@ -53,6 +54,16 @@ from explicit_hybrid_mpc_tpu.parallel.mesh import serving_placement
 from explicit_hybrid_mpc_tpu.partition.tree import NO_CHILD
 
 _MIN_BUCKET = 8
+
+# Largest padding bucket a single evaluate/locate call may mint.  A
+# query batch beyond this is SPLIT into max-bucket chunks instead of
+# silently compiling a fresh (and likely never-reused) device shape --
+# the serving-side counterpart of the build's RecompileGuard.  The
+# split is observable: a health.oversized_batch event (warn severity,
+# adopted by obs.health.HealthMonitor) plus the serve.oversized_batches
+# counter.  The value lives in config.py so ServeConfig's deploy-time
+# validation compares against the same number.
+_DEFAULT_MAX_BUCKET = config_mod.DEFAULT_MAX_BUCKET
 
 # Batch-size histogram bounds: power-of-two edges matching the padding
 # buckets, so the distribution reads directly as compiled-shape usage.
@@ -130,8 +141,21 @@ class ShardedDescent:
                  n_shards: Optional[int] = None,
                  devices: Optional[Sequence[jax.Device]] = None,
                  granularity: int = 8, router=None,
-                 obs: "obs_lib.Obs | None" = None):
+                 obs: "obs_lib.Obs | None" = None,
+                 max_bucket: Optional[int] = None):
         devices = list(devices if devices is not None else jax.devices())
+        self.max_bucket = int(max_bucket if max_bucket is not None
+                              else _DEFAULT_MAX_BUCKET)
+        if self.max_bucket < _MIN_BUCKET \
+                or not config_mod.is_pow2(self.max_bucket):
+            raise ValueError(f"max_bucket must be a power of two >= "
+                             f"{_MIN_BUCKET}, got {self.max_bucket}")
+        # Extra fields merged into every serve.eval heartbeat event:
+        # the request scheduler (serve/scheduler.py) writes its
+        # queue_depth / batch_fill_frac here so stream consumers
+        # (scripts/obs_watch.py) can alarm on serving stalls, not just
+        # build stalls.
+        self.heartbeat: dict = {}
         # Serving observability (obs subsystem): per-shard query-latency
         # histograms, batch sizes, routing counters, imbalance gauge.
         # NOOP by default -- the hot path pays one boolean test per
@@ -281,6 +305,7 @@ class ShardedDescent:
                 "queries": m.counter("serve.queries"),
                 "query_s": m.histogram("serve.query_s"),
                 "locate_q": m.counter("serve.locate_queries"),
+                "oversized": m.counter("serve.oversized_batches"),
             }
 
     # -- host routing ------------------------------------------------------
@@ -355,12 +380,46 @@ class ShardedDescent:
                 else np.full(local.size, -1))
         return np.where(local >= 0, glob, -1)
 
+    def _note_oversized(self, B: int, n_chunks: int) -> None:
+        """A batch beyond the largest padding bucket: record the split
+        as a health.* event (warn severity -- HealthMonitor ADOPTS
+        these, so obs_watch and the in-build watchdog both see it) --
+        the old behavior silently minted a fresh compiled shape per
+        distinct oversized size."""
+        if self._ms:
+            self._ms["oversized"].inc()
+        self._obs.event(
+            "health.oversized_batch", severity="warn", value=B,
+            threshold=self.max_bucket,
+            msg=(f"query batch of {B} exceeds the largest padding "
+                 f"bucket {self.max_bucket}; split into {n_chunks} "
+                 "max-bucket chunks instead of compiling a new shape"))
+
     def evaluate(self, thetas: np.ndarray, tol: float = 1e-9
                  ) -> EvalResult:
         """Batched PWA evaluation, same contract as
         descent.evaluate_descent; `leaf` is the global leaf-table row.
-        Accepts/returns host numpy (the serving boundary)."""
+        Accepts/returns host numpy (the serving boundary).  Batches
+        beyond `max_bucket` are split into max-bucket chunks (see
+        _note_oversized) -- results are identical (every field is
+        computed row-independently), only the dispatch granularity
+        changes."""
         thetas = np.asarray(thetas, dtype=np.float64)
+        B = thetas.shape[0]
+        if B > self.max_bucket:
+            step = self.max_bucket
+            self._note_oversized(B, -(-B // step))
+            parts = [self._evaluate_bounded(thetas[lo:lo + step], tol)
+                     for lo in range(0, B, step)]
+            return EvalResult(
+                u=np.concatenate([p.u for p in parts]),
+                cost=np.concatenate([p.cost for p in parts]),
+                leaf=np.concatenate([p.leaf for p in parts]),
+                inside=np.concatenate([p.inside for p in parts]))
+        return self._evaluate_bounded(thetas, tol)
+
+    def _evaluate_bounded(self, thetas: np.ndarray, tol: float
+                          ) -> EvalResult:
         B = thetas.shape[0]
         ms = self._ms
         t0 = time.perf_counter() if ms else 0.0
@@ -402,7 +461,8 @@ class ShardedDescent:
             self._obs.event("serve.eval", batch=B,
                             wall_s=round(wall, 6),
                             us_per_query=round(wall / max(B, 1) * 1e6,
-                                               3))
+                                               3),
+                            **self.heartbeat)
         return EvalResult(u=u, cost=cost, leaf=leaf, inside=inside)
 
     def _shards_n_u(self) -> int:
@@ -413,8 +473,21 @@ class ShardedDescent:
 
     def locate(self, thetas: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
         """(global leaf-table row, global tree node id) per query; -1
-        row where the descent lands on a payload-free leaf."""
+        row where the descent lands on a payload-free leaf.  Oversized
+        batches split like evaluate()."""
         thetas = np.asarray(thetas, dtype=np.float64)
+        B = thetas.shape[0]
+        if B > self.max_bucket:
+            step = self.max_bucket
+            self._note_oversized(B, -(-B // step))
+            parts = [self._locate_bounded(thetas[lo:lo + step])
+                     for lo in range(0, B, step)]
+            return (np.concatenate([r for r, _n in parts]),
+                    np.concatenate([n for _r, n in parts]))
+        return self._locate_bounded(thetas)
+
+    def _locate_bounded(self, thetas: np.ndarray
+                        ) -> tuple[np.ndarray, np.ndarray]:
         B = thetas.shape[0]
         if self._ms:
             self._ms["locate_q"].inc(B)
@@ -441,7 +514,8 @@ def shard_descent(dt: DescentTable, table: LeafTable,
                   n_shards: Optional[int] = None,
                   devices: Optional[Sequence[jax.Device]] = None,
                   granularity: int = 8, router=None,
-                  obs: "obs_lib.Obs | None" = None) -> ShardedDescent:
+                  obs: "obs_lib.Obs | None" = None,
+                  max_bucket: Optional[int] = None) -> ShardedDescent:
     """Build the sharded server from host-side descent + leaf tables.
 
     `dt` should be a host export (descent.export_descent(..., stage=
@@ -453,4 +527,5 @@ def shard_descent(dt: DescentTable, table: LeafTable,
     problem.root_splits) for engine-built trees -- replaces the
     O(R)-per-query brute root scan."""
     return ShardedDescent(dt, table, n_shards=n_shards, devices=devices,
-                          granularity=granularity, router=router, obs=obs)
+                          granularity=granularity, router=router, obs=obs,
+                          max_bucket=max_bucket)

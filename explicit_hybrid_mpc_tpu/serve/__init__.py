@@ -1,0 +1,41 @@
+"""Online serving runtime over the sharded descent evaluator.
+
+The paper's whole point is that the offline tree makes online control a
+microsecond PWA evaluation (PAPER.md section 4.2); the online stack
+(online/descent.py, online/sharded.py, online/pallas_eval.py) provides
+the fast *kernel*, but a kernel is not a *service*.  This package adds
+the three things between them:
+
+- ``serve/scheduler.py`` -- a deadline-aware request scheduler: a
+  thread-safe submission queue feeding power-of-two micro-batches,
+  flushed on ``max_batch`` or ``max_wait_us`` (whichever lands first),
+  reusing online/sharded.py's bucket discipline so the compiled-shape
+  set stays bounded under arbitrary traffic.
+- ``serve/registry.py`` -- a versioned controller registry: named
+  controllers map to exported artifacts (the flat ``.npy``/``.npz``
+  leaf/descent tables from online/export.py + online/descent.py), and a
+  freshly built tree hot-swaps in atomically while in-flight batches
+  drain against the old version (two-epoch handoff: the old version is
+  retired only after its last leased batch completes).
+- ``serve/fallback.py`` -- degraded-mode handling for queries the
+  certified partition cannot serve (outside the box, or landing on an
+  uncertified hole leaf): clamp-to-nearest-certified-leaf by default,
+  optional host-side oracle re-solve for a bounded fraction of traffic,
+  with per-cause counters so the fallback rate is an SLO.
+
+Observability rides the obs subsystem: per-controller latency
+histograms, queue-depth / batch-fill gauges, ``serve.swap`` /
+``serve.retired`` / ``serve.fallback`` events, and two serving health
+rules (``serve_p99_us``, ``fallback_frac`` -- obs/health.py).
+``scripts/serve_bench.py`` is the closed-loop load generator;
+``python -m explicit_hybrid_mpc_tpu.main serve`` the CLI entry point.
+Architecture + tuning: docs/serving.md.
+"""
+
+from __future__ import annotations
+
+from explicit_hybrid_mpc_tpu.serve.fallback import FallbackPolicy  # noqa: F401
+from explicit_hybrid_mpc_tpu.serve.registry import (  # noqa: F401
+    ControllerRegistry, ControllerVersion, root_box, save_artifacts)
+from explicit_hybrid_mpc_tpu.serve.scheduler import (  # noqa: F401
+    RequestScheduler, ServeResult)

@@ -1,0 +1,189 @@
+"""Degraded-mode fallback for queries the certified partition misses.
+
+The offline tree certifies a bounded box; a live service sees whatever
+state estimation produces.  Two distinct miss causes (per-cause
+counters -- the split matters operationally):
+
+- ``outside_box``: the query lies outside the triangulated parameter
+  box entirely (estimator transient, actuator saturation upstream).
+  The default policy CLAMPS the query to the certified box and
+  re-evaluates: the nearest certified leaf's law, evaluated at the
+  clamped point -- continuous with the in-box law on the boundary, and
+  the standard explicit-MPC practice for box excursions.
+- ``hole``: the query is inside the box but the descent lands on a
+  leaf with no certified payload (an uncertified depth-capped cell, or
+  an infeasible region the build proved empty).  Clamping cannot help
+  (the point IS in the box); the optional **oracle re-solve** path
+  solves the full point MICP on the host for a BOUNDED fraction of
+  traffic (``max_oracle_frac`` of requests seen, a running budget --
+  a hole storm must degrade to best-effort answers, not turn the
+  serving host into an accidental build cluster).
+
+Every fallback outcome is tagged on the per-request result
+(``ServeResult.fallback``: None | 'clamp' | 'oracle' | 'unserved') and
+counted (``serve.fallback.*``); the scheduler folds the rolling rate
+into the ``serve.ctl.<name>.fallback_frac`` gauge, which the ``fallback_frac``
+health rule (obs/health.py) treats as an SLO.
+"""
+
+from __future__ import annotations
+
+import weakref
+from typing import Optional
+
+import numpy as np
+
+from explicit_hybrid_mpc_tpu import obs as obs_lib
+from explicit_hybrid_mpc_tpu.online.evaluator import EvalResult
+
+#: Fallback cause/outcome tags, in the order counters are reported.
+CAUSES = ("outside_box", "hole")
+OUTCOMES = ("clamp", "oracle", "unserved")
+
+
+class FallbackPolicy:
+    """Clamp-to-certified-box with optional budgeted oracle re-solve.
+
+    `lb`/`ub`: the DEFAULT certified parameter box
+    (serve.registry.root_box recovers it from the descent artifact).
+    At apply() time the box is re-derived from the LEASED server's own
+    root_bary whenever it carries one (cached per server), so a hot
+    swap to a tree rebuilt on a different box clamps to the new
+    version's certified boundary, not the boot-time one; the
+    constructor box serves servers without root_bary.  `oracle`: an
+    object with ``solve_vertices(thetas) -> VertexSolution``
+    (oracle.Oracle / SOCOracle) or None; `max_oracle_frac` bounds
+    oracle re-solves to that fraction of ALL requests seen (running
+    budget, so a burst of holes early cannot starve the budget
+    forever)."""
+
+    def __init__(self, lb: np.ndarray, ub: np.ndarray,
+                 mode: str = "clamp", oracle=None,
+                 max_oracle_frac: float = 0.05,
+                 obs: "obs_lib.Obs | None" = None):
+        if mode not in ("clamp", "off"):
+            raise ValueError(f"unknown fallback mode {mode!r} "
+                             "(expected 'clamp' or 'off')")
+        self.lb = np.asarray(lb, dtype=np.float64)
+        self.ub = np.asarray(ub, dtype=np.float64)
+        self.mode = mode
+        self.oracle = oracle
+        self.max_oracle_frac = float(max_oracle_frac)
+        self._obs = obs if obs is not None else obs_lib.NOOP
+        # Per-server certified boxes (weak: retired versions must stay
+        # collectable; a recycled id() can never alias a stale box).
+        self._boxes: "weakref.WeakKeyDictionary" = \
+            weakref.WeakKeyDictionary()
+        self.n_seen = 0
+        self.n_oracle = 0
+        self._ms = None
+        if self._obs.enabled:
+            m = self._obs.metrics
+            self._ms = {
+                **{c: m.counter(f"serve.fallback.{c}") for c in CAUSES},
+                **{o: m.counter(f"serve.fallback.{o}")
+                   for o in OUTCOMES},
+                "total": m.counter("serve.fallback.requests"),
+            }
+
+    def _count(self, key: str, n: int) -> None:
+        if self._ms and n:
+            self._ms[key].inc(n)
+
+    def _box(self, server) -> tuple[np.ndarray, np.ndarray]:
+        """The certified box of THIS server (see class docstring)."""
+        if getattr(server, "root_bary", None) is None:
+            return self.lb, self.ub
+        try:
+            return self._boxes[server]
+        except (KeyError, TypeError):  # TypeError: not weakref-able
+            pass
+        from explicit_hybrid_mpc_tpu.serve.registry import root_box
+
+        box = root_box(server)
+        try:
+            self._boxes[server] = box
+        except TypeError:
+            pass
+        return box
+
+    def apply(self, thetas: np.ndarray, res: EvalResult, server
+              ) -> tuple[EvalResult, list[Optional[str]]]:
+        """Resolve the not-inside rows of one evaluated batch.
+
+        Returns (patched EvalResult, per-row outcome tags).  `server`
+        is the SAME leased version the batch evaluated on -- the clamp
+        re-evaluation must not straddle a hot swap (the scheduler holds
+        the lease across this call)."""
+        B = thetas.shape[0]
+        self.n_seen += B
+        tags: list[Optional[str]] = [None] * B
+        bad = np.flatnonzero(~res.inside)
+        if bad.size == 0 or self.mode == "off":
+            return res, tags
+        lb, ub = self._box(server)
+        u = np.array(res.u)
+        cost = np.array(res.cost)
+        leaf = np.array(res.leaf)
+        inside = np.array(res.inside)
+
+        outside = np.zeros(B, dtype=bool)
+        outside[bad] = ((thetas[bad] < lb)
+                        | (thetas[bad] > ub)).any(axis=1)
+        n_out = int(outside.sum())
+        self._count("outside_box", n_out)
+        self._count("hole", bad.size - n_out)
+        self._count("total", bad.size)
+
+        # Clamp pass: one re-evaluation of ALL bad rows at their
+        # box-clamped coordinates (for in-box holes the clamp is the
+        # identity, but a hole's neighbors may still catch the query
+        # when the miss was a knife-edge lam < -tol rejection).
+        clamped = np.clip(thetas[bad], lb, ub)
+        res2 = server.evaluate(clamped)
+        served = np.asarray(res2.inside)
+        rows = bad[served]
+        u[rows] = np.asarray(res2.u)[served]
+        cost[rows] = np.asarray(res2.cost)[served]
+        leaf[rows] = np.asarray(res2.leaf)[served]
+        inside[rows] = True
+        for i in rows:
+            tags[int(i)] = "clamp"
+        self._count("clamp", rows.size)
+
+        # Oracle re-solve for what the clamp could not serve, under the
+        # running budget.
+        left = bad[~served]
+        if left.size and self.oracle is not None:
+            budget = int(self.max_oracle_frac * self.n_seen) \
+                - self.n_oracle
+            take = left[:max(0, budget)]
+            if take.size:
+                self.n_oracle += take.size
+                sol = self.oracle.solve_vertices(thetas[take])
+                dstar = np.asarray(sol.dstar)
+                hit = dstar >= 0
+                # Only hits are patched in; an oracle MISS (no valid
+                # commutation, dstar=-1) leaves the raw evaluated row
+                # untouched -- 'unserved' means untouched, and u0 rows
+                # behind a miss are unconverged garbage (Vstar +inf
+                # would also break strict-JSON result consumers).
+                kk = np.flatnonzero(hit)
+                rows_ok = take[kk]
+                u[rows_ok] = np.asarray(sol.u0)[kk, dstar[kk]]
+                cost[rows_ok] = np.asarray(sol.Vstar)[kk]
+                inside[rows_ok] = True
+                for k, i in enumerate(take):
+                    tags[int(i)] = "oracle" if hit[k] else "unserved"
+                self._count("oracle", int(hit.sum()))
+                self._count("unserved", int((~hit).sum()))
+                left = left[max(0, budget):]
+            if left.size:
+                self._count("unserved", left.size)
+                for i in left:
+                    tags[int(i)] = "unserved"
+        elif left.size:
+            self._count("unserved", left.size)
+            for i in left:
+                tags[int(i)] = "unserved"
+        return EvalResult(u=u, cost=cost, leaf=leaf, inside=inside), tags

@@ -1,0 +1,374 @@
+"""Deadline-aware request scheduler: queue -> micro-batch -> device.
+
+A stream of independent control queries (one state estimate each, or a
+small burst from a multi-plant client) must become PADDED DEVICE
+BATCHES to amortize dispatch overhead -- but a control loop has a
+deadline, so a query cannot sit in the queue waiting for friends
+forever.  The scheduler resolves the tension the standard way:
+
+- ``submit`` / ``submit_batch`` enqueue onto a thread-safe queue and
+  return a ticket; ``Ticket.result(timeout)`` blocks the caller.
+- A worker thread flushes a micro-batch when EITHER the queue holds
+  ``max_batch`` rows OR the oldest queued row has waited
+  ``max_wait_us`` -- the deadline budget.  Under heavy offered load
+  batches fill to ``max_batch`` (throughput mode); under trickle load
+  the deadline bounds added latency to one wait budget.
+- Batches are padded to power-of-two buckets by the sharded evaluator
+  (online/sharded.py bucket discipline, ``max_batch`` itself a power
+  of two), so arbitrary traffic shapes never mint new compiled shapes
+  -- the same invariant tpulint/RecompileGuard enforce on the build.
+
+Every batch is evaluated under ONE registry lease
+(serve/registry.py): the whole batch sees one tree version, results
+are tagged with it, and a hot swap mid-traffic never tears a batch.
+Not-inside rows route through the FallbackPolicy before results
+scatter back to tickets.
+
+Observability: ALL scheduler metrics are namespaced per controller
+(``serve.ctl.<name>.request_s`` latency histogram, ``.queue_depth`` /
+``.batch_fill_frac`` / rolling ``.p99_us`` / ``.fallback_frac``
+gauges, ``.requests`` / ``.batches`` counters) so several schedulers
+sharing one obs handle never overwrite each other's gauges; the
+un-namespaced ``serve.requests`` / ``serve.batches`` counters remain
+as true cross-controller aggregates (increments sum).  The worker
+also flushes a metrics snapshot into the stream every
+``METRICS_FLUSH_S`` seconds of traffic, so the serving health rules
+(obs/health.py ``serve_p99_us`` / ``fallback_frac``) and an external
+tailer (scripts/obs_watch.py) see SLO breaches live, not only in the
+final close() snapshot.  The per-batch ``serve.eval`` heartbeat
+(emitted by the sharded evaluator) carries queue_depth +
+batch_fill_frac so obs_watch can alarm on serving stalls.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from collections import deque
+from typing import Optional
+
+import numpy as np
+
+from explicit_hybrid_mpc_tpu import config as config_mod
+from explicit_hybrid_mpc_tpu import obs as obs_lib
+from explicit_hybrid_mpc_tpu.online import sharded as sharded_mod
+
+#: Rolling window (requests) behind the p99_us / fallback_frac
+#: gauges: large enough to smooth batch quantization, small enough that
+#: an SLO breach surfaces within seconds at production rates.
+_ROLL_WINDOW = 1024
+
+#: Minimum seconds between metrics-snapshot flushes from the worker
+#: loop.  The build flushes every metrics_every_steps steps
+#: (frontier.py); serving has no step counter, so the cadence is wall
+#: time under traffic (an idle scheduler writes nothing -- the stall
+#: rule covers frozen streams).
+METRICS_FLUSH_S = 2.0
+
+#: Guards the cross-controller aggregate counters (serve.requests /
+#: serve.batches): obs Counters are single-producer by contract, and
+#: several schedulers' threads share these two names.
+_AGG_LOCK = threading.Lock()
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeResult:
+    """One request's answer (host scalars/arrays; the serving boundary).
+
+    ``fallback`` is None on the certified fast path, else the
+    degraded-mode outcome tag ('clamp' | 'oracle' | 'unserved' --
+    serve/fallback.py); ``ok`` is the serve-level success flag (a
+    certified or fallback-served answer)."""
+
+    u: np.ndarray
+    cost: float
+    leaf: int
+    inside: bool
+    version: str
+    fallback: Optional[str]
+    latency_s: float
+
+    @property
+    def ok(self) -> bool:
+        return bool(self.inside)
+
+
+class Ticket:
+    """Caller-side handle for one submission (k rows)."""
+
+    __slots__ = ("_evt", "_results", "_error", "t_submit", "n")
+
+    def __init__(self, n: int):
+        self._evt = threading.Event()
+        self._results: list[Optional[ServeResult]] = [None] * n
+        self._error: Optional[BaseException] = None
+        self.t_submit = time.perf_counter()
+        self.n = n
+
+    def _fill(self, offset: int, results: list[ServeResult]) -> None:
+        self._results[offset:offset + len(results)] = results
+        if all(r is not None for r in self._results):
+            self._evt.set()
+
+    def _fail(self, err: BaseException) -> None:
+        self._error = err
+        self._evt.set()
+
+    def done(self) -> bool:
+        return self._evt.is_set()
+
+    def result(self, timeout: Optional[float] = None
+               ) -> list[ServeResult]:
+        """Block until every row is served; raises TimeoutError on
+        `timeout`, or the scheduler-side error on failure."""
+        if not self._evt.wait(timeout):
+            raise TimeoutError(
+                f"serve ticket not complete within {timeout}s")
+        if self._error is not None:
+            raise self._error
+        return list(self._results)  # type: ignore[arg-type]
+
+
+class _Pending:
+    """One queued submission; `done` rows already claimed by batches."""
+
+    __slots__ = ("ticket", "thetas", "done")
+
+    def __init__(self, ticket: Ticket, thetas: np.ndarray):
+        self.ticket = ticket
+        self.thetas = thetas
+        self.done = 0
+
+
+class RequestScheduler:
+    """Micro-batching front end over a ControllerRegistry entry.
+
+    One scheduler serves one controller name; run several for several
+    controllers (they share the registry and the obs handle).  Start
+    is implicit on construction; ``close()`` drains the queue and
+    stops the worker (no request is ever dropped by a clean
+    shutdown)."""
+
+    def __init__(self, registry, controller: str,
+                 max_batch: int = 256, max_wait_us: float = 2000.0,
+                 fallback=None, obs: "obs_lib.Obs | None" = None):
+        if not config_mod.is_pow2(max_batch):
+            raise ValueError(f"max_batch must be a power of two, "
+                             f"got {max_batch}")
+        if max_wait_us <= 0:
+            raise ValueError("max_wait_us must be > 0")
+        self.registry = registry
+        self.controller = controller
+        self.max_batch = int(max_batch)
+        self.max_wait_s = float(max_wait_us) * 1e-6
+        self.fallback = fallback
+        self._obs = obs if obs is not None else obs_lib.NOOP
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._queue: deque[_Pending] = deque()
+        self._queued_rows = 0
+        self._closed = False
+        self.n_requests = 0
+        self.n_batches = 0
+        self._lat_roll: deque[float] = deque(maxlen=_ROLL_WINDOW)
+        self._fb_roll: deque[int] = deque(maxlen=_ROLL_WINDOW)
+        self._fill_roll: deque[float] = deque(maxlen=64)
+        self._last_flush = time.perf_counter()
+        self._ms = None
+        if self._obs.enabled:
+            m = self._obs.metrics
+            ns = f"serve.ctl.{controller}"
+            self._ms = {
+                "req_s": m.histogram(f"{ns}.request_s"),
+                "batch_fill": m.histogram(f"{ns}.batch_fill"),
+                "depth": m.gauge(f"{ns}.queue_depth"),
+                "fill": m.gauge(f"{ns}.batch_fill_frac"),
+                "p99": m.gauge(f"{ns}.p99_us"),
+                "fb_frac": m.gauge(f"{ns}.fallback_frac"),
+                "requests": m.counter(f"{ns}.requests"),
+                "batches": m.counter(f"{ns}.batches"),
+                # Cross-controller aggregates, incremented under
+                # _AGG_LOCK (obs Counters are single-producer by
+                # contract and these two names are shared; gauges
+                # would flip-flop -- those live only under the
+                # namespace).
+                "requests_all": m.counter("serve.requests"),
+                "batches_all": m.counter("serve.batches"),
+            }
+        self._worker = threading.Thread(
+            target=self._loop, name=f"serve-{controller}", daemon=True)
+        self._worker.start()
+
+    # -- submission --------------------------------------------------------
+
+    def submit(self, theta: np.ndarray) -> Ticket:
+        """Enqueue ONE query (p,); Ticket.result() -> [ServeResult]."""
+        return self.submit_batch(np.atleast_2d(theta))
+
+    def submit_batch(self, thetas: np.ndarray) -> Ticket:
+        """Enqueue a small batch (k, p); rows may be split across
+        micro-batches (each row still evaluates on exactly one
+        version).  Large k is legal -- the scheduler chunks it.
+
+        Shape is validated HERE, against the submitting caller: a
+        malformed submission must raise on its own thread, not poison
+        the np.concatenate of a micro-batch it shares with other
+        clients' healthy rows."""
+        thetas = np.atleast_2d(np.asarray(thetas, dtype=np.float64))
+        if thetas.ndim != 2:
+            raise ValueError(f"thetas must be (k, p), got shape "
+                             f"{thetas.shape}")
+        # Queried per submit, not cached: the width is a
+        # publish-enforced invariant of the controller name
+        # (registry.publish rejects a different-width version), so
+        # this can only transition None -> p when the controller is
+        # first published -- never change under queued traffic.
+        p = self.registry.param_dim(self.controller)
+        if p is not None and thetas.shape[1] != p:
+            raise ValueError(
+                f"theta width {thetas.shape[1]} does not match "
+                f"controller {self.controller!r} parameter dim {p}")
+        t = Ticket(thetas.shape[0])
+        with self._cond:
+            if self._closed:
+                raise RuntimeError("scheduler is closed")
+            self._queue.append(_Pending(t, thetas))
+            self._queued_rows += thetas.shape[0]
+            self.n_requests += thetas.shape[0]
+            if self._ms:
+                self._ms["requests"].inc(thetas.shape[0])
+                with _AGG_LOCK:
+                    self._ms["requests_all"].inc(thetas.shape[0])
+                self._ms["depth"].set(self._queued_rows)
+            self._cond.notify()
+        return t
+
+    def queue_depth(self) -> int:
+        with self._lock:
+            return self._queued_rows
+
+    # -- worker ------------------------------------------------------------
+
+    def _collect(self) -> list[tuple[Ticket, int, np.ndarray]]:
+        """Block until a flush condition holds, then claim up to
+        max_batch rows: [(ticket, row offset in ticket, rows)]."""
+        with self._cond:
+            while True:
+                if self._queue:
+                    oldest = self._queue[0].ticket.t_submit
+                    budget = oldest + self.max_wait_s \
+                        - time.perf_counter()
+                    if self._queued_rows >= self.max_batch \
+                            or budget <= 0 or self._closed:
+                        break
+                    self._cond.wait(timeout=budget)
+                elif self._closed:
+                    return []
+                else:
+                    self._cond.wait()
+            out = []
+            room = self.max_batch
+            while room and self._queue:
+                pend = self._queue[0]
+                take = min(room, pend.thetas.shape[0] - pend.done)
+                out.append((pend.ticket, pend.done,
+                            pend.thetas[pend.done:pend.done + take]))
+                pend.done += take
+                room -= take
+                self._queued_rows -= take
+                if pend.done == pend.thetas.shape[0]:
+                    self._queue.popleft()
+            if self._ms:
+                self._ms["depth"].set(self._queued_rows)
+            return out
+
+    def _loop(self) -> None:
+        while True:
+            entries = self._collect()
+            if not entries:
+                return  # closed and drained
+            try:
+                self._serve(entries)
+            except BaseException as e:  # noqa: BLE001 -- scatter, don't die
+                for ticket, _off, _rows in entries:
+                    ticket._fail(e)
+            # Periodic metrics snapshot into the stream: without it the
+            # serving SLO gauges reach the health rules only at close()
+            # -- a post-mortem, not an alarm.
+            if self._ms:
+                now = time.perf_counter()
+                if now - self._last_flush >= METRICS_FLUSH_S:
+                    self._last_flush = now
+                    self._obs.flush_metrics()
+
+    def _serve(self, entries) -> None:
+        thetas = np.concatenate([rows for _t, _o, rows in entries])
+        B = thetas.shape[0]
+        fill = B / min(sharded_mod._bucket(B), self.max_batch)
+        self._fill_roll.append(fill)
+        self.n_batches += 1
+        with self.registry.lease(self.controller) as ver:
+            srv = ver.server
+            # Heartbeat context for the evaluator's serve.eval event
+            # (obs_watch alarms on serving stalls via these fields).
+            hb = getattr(srv, "heartbeat", None)
+            if hb is not None:
+                hb["queue_depth"] = self.queue_depth()
+                hb["batch_fill_frac"] = round(
+                    sum(self._fill_roll) / len(self._fill_roll), 4)
+            res = srv.evaluate(thetas)
+            if self.fallback is not None:
+                res, tags = self.fallback.apply(thetas, res, srv)
+            else:
+                tags = [None] * B
+        now = time.perf_counter()
+        version = ver.version
+        if self._ms:
+            self._ms["batches"].inc()
+            with _AGG_LOCK:
+                self._ms["batches_all"].inc()
+            self._ms["batch_fill"].observe(fill)
+            self._ms["fill"].set(
+                sum(self._fill_roll) / len(self._fill_roll))
+        lo = 0
+        for ticket, off, rows in entries:
+            k = rows.shape[0]
+            lat = now - ticket.t_submit
+            results = [
+                ServeResult(u=np.array(res.u[lo + i]),
+                            cost=float(res.cost[lo + i]),
+                            leaf=int(res.leaf[lo + i]),
+                            inside=bool(res.inside[lo + i]),
+                            version=version,
+                            fallback=tags[lo + i],
+                            latency_s=lat)
+                for i in range(k)]
+            self._lat_roll.extend([lat] * k)
+            self._fb_roll.extend(
+                [0 if t is None else 1 for t in tags[lo:lo + k]])
+            if self._ms:
+                self._ms["req_s"].observe(lat, n=k)
+            ticket._fill(off, results)
+            lo += k
+        if self._ms and self._lat_roll:
+            lat_us = np.asarray(self._lat_roll) * 1e6
+            self._ms["p99"].set(float(np.percentile(lat_us, 99)))
+            self._ms["fb_frac"].set(
+                sum(self._fb_roll) / len(self._fb_roll))
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def close(self, timeout: Optional[float] = 30.0) -> None:
+        """Stop accepting, drain everything queued, join the worker.
+        A clean close never drops a request."""
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+        self._worker.join(timeout)
+
+    def __enter__(self) -> "RequestScheduler":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -71,10 +71,22 @@ GATED_METRICS: dict[str, tuple] = {
     # absolute slack.)
     "pipeline_fill_frac": ("higher", 0.15),
     "spec_waste_frac": ("lower", 0.15, 0.02),
+    # Serving runtime (scripts/serve_bench.py rows): closed-loop p99
+    # on a contended 2-core CI host is noisy, so the latency gate gets
+    # a wide relative band plus an absolute slack; the fallback rate
+    # is the serving SLO (docs/serving.md) and near zero on a healthy
+    # synthetic sweep, so it gates like spec_waste_frac.  Rows carry
+    # DISJOINT metric keys per family (serve rows have no "value",
+    # build rows no "serve_*"), so the trailing windows never mix
+    # regions/s with QPS semantics.
+    "serve_p99_us": ("lower", 0.25, 1000.0),
+    "fallback_frac": ("lower", 0.15, 0.02),
 }
 
 _ROW_EXTRAS = ("regions", "unit", "precision", "truncated",
-               "device_failures", "uncertified")
+               "device_failures", "uncertified",
+               "serve_qps", "serve_batch_fill", "swap_dropped",
+               "swap_torn")
 
 
 def summarize(bench: dict, source: str, mtime: float | None = None) -> dict:
@@ -127,11 +139,13 @@ def append_history(bench: dict, source: str, path: str = HISTORY,
     (updated in place); roll_history passes one so a sweep over N
     artifacts re-reads the history once, not N times."""
     row = summarize(bench, source, mtime)
-    if row.get("value") is None and not row.get("error"):
-        # A capture that produced neither a headline value nor an error
+    if all(row.get(m) is None for m in GATED_METRICS) \
+            and not row.get("error"):
+        # A capture that produced no gated metric at all and no error
         # (e.g. a driver wrapper with parsed: null) carries no gating
         # information; recording it as a clean all-null row would
-        # pollute the history forever.
+        # pollute the history forever.  (Serve rows carry serve_* but
+        # no "value" -- they gate their own metric family.)
         return None
     if seen is None:
         seen = _seen_keys(load_history(path))
@@ -208,8 +222,13 @@ def gate(candidate: dict, history: list[dict], tol: dict | None = None,
         cand = candidate.get(metric)
         if cand is None:
             continue
-        vals = [r[metric] for r in base[-window:]
-                if isinstance(r.get(metric), (int, float))]
+        # Filter to rows CARRYING this metric before taking the
+        # trailing window: history rows from another metric family
+        # (serve rows next to build rows) must not evict this family's
+        # rows out of the window and silently un-gate it.
+        carrying = [r for r in base
+                    if isinstance(r.get(metric), (int, float))]
+        vals = [r[metric] for r in carrying[-window:]]
         # All-zero history (e.g. wasted_iter_frac before two-phase
         # existed) carries no regression information for purely
         # RELATIVE metrics.  Metrics with an absolute slack keep their

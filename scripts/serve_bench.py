@@ -1,0 +1,275 @@
+"""Closed-loop serving load generator: offered-QPS sweep + hot swap.
+
+Drives the online serving runtime (explicit_hybrid_mpc_tpu/serve/)
+against a synthetic partition (partition/synthetic.py -- serving only
+cares about the TREE, so the sweep needs no oracle solves):
+
+1. Build controller **v1** (a balanced depth-D bisection tree with the
+   synthetic linear law) and publish it; **v2** is the same geometry
+   with every payload DOUBLED -- doubling is exact in floating point,
+   so v2 results are bitwise 2x v1 results and a torn cross-version
+   read is detectable bit-for-bit.
+2. For each offered rate, N closed-loop clients pace single-query
+   submissions through the RequestScheduler (pow-2 micro-batches under
+   the ``max_wait_us`` deadline); a configurable fraction of queries
+   lands outside the certified box to keep the fallback path hot.
+3. Mid-run at the TOP offered rate, v2 hot-swaps in
+   (ControllerRegistry.publish).  The sweep then verifies the swap
+   contract: ZERO dropped/errored requests, the old version drains
+   (serve.retired), and every result is bit-identical to ITS version's
+   reference evaluation -- never a mix.
+4. One JSON artifact (``SERVE_BENCH_OUT``, default
+   artifacts/serve_bench.json) plus a condensed ``serve_*`` row
+   appended to BENCH_HISTORY.jsonl (scripts/bench_gate.py gates
+   serve_p99_us / fallback_frac against the trailing window; env
+   BENCH_HISTORY="" disables, as for bench.py).
+
+Env knobs (defaults target the tier-1 CPU config):
+    SERVE_BENCH_P=2 SERVE_BENCH_DEPTH=9 SERVE_BENCH_NU=2
+    SERVE_BENCH_SHARDS=2 SERVE_BENCH_CLIENTS=8
+    SERVE_BENCH_RATES=1000,4000,16000 SERVE_BENCH_SECS=2.0
+    SERVE_BENCH_MAX_BATCH=64 SERVE_BENCH_WAIT_US=2000
+    SERVE_BENCH_OUTSIDE_FRAC=0.05 SERVE_BENCH_OUT=...
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _env(name: str, default, cast=float):
+    v = os.environ.get(name)
+    return default if v in (None, "") else cast(v)
+
+
+def _percentile_us(lat_s: list[float], q: float) -> float:
+    return round(float(np.percentile(np.asarray(lat_s) * 1e6, q)), 3)
+
+
+def run(out_path: str | None = None) -> dict:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from explicit_hybrid_mpc_tpu import obs as obs_lib
+    from explicit_hybrid_mpc_tpu.obs.host import ContentionMonitor
+    from explicit_hybrid_mpc_tpu.online import descent, export, sharded
+    from explicit_hybrid_mpc_tpu.partition.synthetic import \
+        build_synthetic_tree
+    from explicit_hybrid_mpc_tpu.serve import (ControllerRegistry,
+                                               FallbackPolicy,
+                                               RequestScheduler, root_box)
+
+    p = int(_env("SERVE_BENCH_P", 2, int))
+    depth = int(_env("SERVE_BENCH_DEPTH", 9, int))
+    n_u = int(_env("SERVE_BENCH_NU", 2, int))
+    n_shards = int(_env("SERVE_BENCH_SHARDS", 2, int))
+    n_clients = int(_env("SERVE_BENCH_CLIENTS", 8, int))
+    rates = [float(r) for r in str(
+        _env("SERVE_BENCH_RATES", "1000,4000,16000", str)).split(",")]
+    secs = _env("SERVE_BENCH_SECS", 2.0)
+    max_batch = int(_env("SERVE_BENCH_MAX_BATCH", 64, int))
+    wait_us = _env("SERVE_BENCH_WAIT_US", 2000.0)
+    outside_frac = _env("SERVE_BENCH_OUTSIDE_FRAC", 0.05)
+
+    def build(scale: float):
+        tree, roots = build_synthetic_tree(p=p, depth=depth, n_u=n_u)
+        if scale != 1.0:
+            # Exact power-of-two payload scaling: v2 = bitwise 2x v1.
+            tree._pl_inputs[:] *= scale
+            tree._pl_costs[:] *= scale
+        table = export.export_leaves(tree)
+        dt = descent.export_descent(tree, roots, table, stage=False)
+        return sharded.shard_descent(dt, table, n_shards=n_shards,
+                                     obs=o)
+
+    o = obs_lib.Obs("jsonl")  # in-memory stream: events + metrics
+    srv1 = build(1.0)
+    srv2 = build(2.0)
+    registry = ControllerRegistry(obs=o)
+    v1 = registry.publish("bench", "v1", srv1)
+    lb, ub = root_box(srv1)
+    fallback = FallbackPolicy(lb, ub, obs=o)
+    sched = RequestScheduler(registry, "bench", max_batch=max_batch,
+                             max_wait_us=wait_us, fallback=fallback,
+                             obs=o)
+
+    # Warm the compiled-shape set before the measured sweep: the pow-2
+    # bucket discipline bounds it to log2(max_batch) programs per
+    # server, but the FIRST compile of each would otherwise land inside
+    # a measured window and dominate that rate's p99.
+    wrng = np.random.default_rng(0)
+    k = 1
+    while k <= max_batch:
+        warm = wrng.uniform(lb, ub, size=(k, p))
+        srv1.evaluate(warm)
+        srv2.evaluate(warm)
+        k *= 2
+
+    # Contention verdict, same protocol as bench.py: a serve row
+    # captured while competing processes ate the host must be marked
+    # contended so bench_gate skips it as a candidate AND excludes it
+    # from the trailing reference window (p99 under load is noise).
+    monitor = ContentionMonitor(
+        interval_s=1.0, metrics=o.metrics if o.enabled else None).start()
+
+    span = ub - lb
+    errors: list[str] = []
+    per_rate = []
+    swap_at: float | None = None
+    records: list[tuple[np.ndarray, object]] = []  # (theta, result)
+    rec_lock = threading.Lock()
+
+    def client(cid: int, rate_per_client: float, t_end: float,
+               lat_out: list, collect: bool):
+        rng = np.random.default_rng(1000 + cid)
+        interval = 1.0 / rate_per_client if rate_per_client > 0 else 0.0
+        t_next = time.perf_counter()
+        while time.perf_counter() < t_end:
+            theta = rng.uniform(lb, ub)
+            outside = rng.uniform() < outside_frac
+            if outside:
+                theta = ub + 0.05 * span * rng.uniform(0.1, 1.0, p)
+            try:
+                (r,) = sched.submit(theta).result(30.0)
+            except Exception as e:  # noqa: BLE001 -- a drop IS the finding
+                errors.append(repr(e))
+                return
+            lat_out.append(r.latency_s)
+            if collect and not outside:
+                with rec_lock:
+                    records.append((theta, r))
+            t_next += interval
+            sleep = t_next - time.perf_counter()
+            if sleep > 0:
+                time.sleep(sleep)
+
+    for i, rate in enumerate(rates):
+        top = i == len(rates) - 1
+        lat: list[float] = []
+        t_end = time.perf_counter() + secs
+        threads = [threading.Thread(
+            target=client, args=(c, rate / n_clients, t_end, lat, top))
+            for c in range(n_clients)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        if top:
+            # Mid-run hot swap at the top offered rate.
+            time.sleep(secs / 2)
+            swap_at = time.perf_counter() - t0
+            registry.publish("bench", "v2", srv2)
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        fill = (sum(sched._fill_roll) / len(sched._fill_roll)
+                if sched._fill_roll else 0.0)
+        per_rate.append({
+            "offered_qps": rate,
+            "achieved_qps": round(len(lat) / wall, 1),
+            "p50_us": _percentile_us(lat, 50) if lat else None,
+            "p99_us": _percentile_us(lat, 99) if lat else None,
+            "batch_fill": round(fill, 4),
+            "requests": len(lat),
+        })
+
+    drained = registry.wait_retired(v1, 10.0)
+    sched.close()
+    host = monitor.summary()
+
+    # Swap-atomicity audit: every top-rate in-box result must equal ITS
+    # version's reference bit-for-bit (v2 refs are exactly 2x v1's).
+    torn = 0
+    if records:
+        thetas = np.stack([th for th, _r in records])
+        ref = srv1.evaluate(thetas)
+        for k, (_th, r) in enumerate(records):
+            scale = 1.0 if r.version == "v1" else 2.0
+            if not (np.array_equal(r.u, scale * ref.u[k])
+                    and r.cost == scale * float(ref.cost[k])):
+                torn += 1
+
+    fb_ms = o.metrics.snapshot()["counters"] if o.enabled else {}
+    n_req = sched.n_requests
+    n_fb = fb_ms.get("serve.fallback.requests", 0)
+    top_row = per_rate[-1]
+    metric = (f"serve p99 us (synthetic p={p} depth={depth} "
+              f"{n_shards} shards, closed-loop x{n_clients}, cpu)")
+    if host.get("contended"):
+        # The verdict rides the metric line itself, as in bench.py: a
+        # contended capture can never read as a clean number.
+        metric += (f" [CONTENDED: competing processes used "
+                   f"{100 * host['competing_cpu_frac_mean']:.0f}% of "
+                   f"CPU]")
+    result = {
+        "metric": metric,
+        "platform": jax.default_backend(),
+        "unit": "us p99",
+        "serve_p99_us": top_row["p99_us"],
+        "fallback_frac": round(n_fb / max(1, n_req), 4),
+        "serve_qps": top_row["achieved_qps"],
+        "serve_batch_fill": top_row["batch_fill"],
+        "swap_dropped": len(errors),
+        "swap_torn": torn,
+        "swap_drained": bool(drained),
+        "swap_at_s": round(swap_at, 3) if swap_at else None,
+        "versions_seen": sorted({r.version for _t, r in records}),
+        "requests": n_req,
+        "batches": sched.n_batches,
+        "rates": per_rate,
+        "host": host,
+        "errors": errors[:5],
+        "config": {"p": p, "depth": depth, "n_u": n_u,
+                   "n_shards": n_shards, "clients": n_clients,
+                   "max_batch": max_batch, "max_wait_us": wait_us,
+                   "outside_frac": outside_frac, "secs": secs},
+    }
+    o.close()
+
+    out = out_path or str(_env(
+        "SERVE_BENCH_OUT",
+        os.path.join(REPO, "artifacts", "serve_bench.json"), str))
+    os.makedirs(os.path.dirname(out) or ".", exist_ok=True)
+    with open(out, "w") as f:
+        json.dump(result, f, indent=2)
+
+    hist_path = os.environ.get("BENCH_HISTORY")
+    if hist_path != "":  # same disable contract as bench.py
+        sys.path.insert(0, os.path.join(REPO, "scripts"))
+        try:
+            import bench_gate
+
+            bench_gate.append_history(
+                result, out, mtime=os.path.getmtime(out),
+                path=hist_path or bench_gate.HISTORY)
+        finally:
+            sys.path.pop(0)
+    return result
+
+
+def main() -> int:
+    result = run()
+    print(json.dumps({k: v for k, v in result.items()
+                      if k not in ("rates",)}))
+    for row in result["rates"]:
+        print(json.dumps(row), file=sys.stderr)
+    # batch_fill >= 0.5 at the top offered rate is the acceptance bar
+    # (ISSUE 8 / docs/serving.md): under saturating load the deadline
+    # must not be flushing near-empty batches.
+    ok = (result["swap_dropped"] == 0 and result["swap_torn"] == 0
+          and result["swap_drained"]
+          and (result["serve_batch_fill"] or 0.0) >= 0.5)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

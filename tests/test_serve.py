@@ -1,0 +1,621 @@
+"""Online serving runtime (explicit_hybrid_mpc_tpu/serve/): micro-batch
+scheduling under a deadline, hot-swap atomicity across a registry
+publish (satellite: concurrent submitters must never observe a torn
+cross-version read), degraded-mode fallback causes/counters, the
+oversized-batch split in online/sharded.py, the serving health rules,
+and the serve_bench closed-loop sweep."""
+
+import json
+import os
+import sys
+import threading
+import time
+from typing import NamedTuple
+
+import numpy as np
+import pytest
+
+from explicit_hybrid_mpc_tpu import obs as obs_lib
+from explicit_hybrid_mpc_tpu.config import ServeConfig
+from explicit_hybrid_mpc_tpu.obs.health import HealthMonitor
+from explicit_hybrid_mpc_tpu.online import descent, export, sharded
+from explicit_hybrid_mpc_tpu.partition.synthetic import build_synthetic_tree
+from explicit_hybrid_mpc_tpu.serve import (ControllerRegistry,
+                                           FallbackPolicy,
+                                           RequestScheduler, root_box,
+                                           save_artifacts)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _server(obs=None, scale=1.0, depth=6, max_bucket=None, p=2, n_u=2):
+    tree, roots = build_synthetic_tree(p=p, depth=depth, n_u=n_u)
+    if scale != 1.0:
+        tree._pl_inputs[:] *= scale
+        tree._pl_costs[:] *= scale
+    table = export.export_leaves(tree)
+    dt = descent.export_descent(tree, roots, table, stage=False)
+    return sharded.shard_descent(dt, table, n_shards=2, obs=obs,
+                                 max_bucket=max_bucket)
+
+
+@pytest.fixture(scope="module")
+def servers():
+    """(v1, v2) servers over the same geometry; v2 payloads are exactly
+    2x v1's, so v2 results are bitwise 2x v1 results."""
+    return _server(), _server(scale=2.0)
+
+
+# -- scheduler ---------------------------------------------------------------
+
+
+def test_scheduler_micro_batches_and_heartbeat(servers, rng):
+    srv1, _ = servers
+    o = obs_lib.Obs("jsonl")
+    srv = _server(obs=o)
+    reg = ControllerRegistry(obs=o)
+    reg.publish("c", "v1", srv)
+    with RequestScheduler(reg, "c", max_batch=32, max_wait_us=1500.0,
+                          obs=o) as sched:
+        thetas = rng.uniform(0, 1, size=(200, 2))
+        tickets = [sched.submit(t) for t in thetas]
+        results = [t.result(30.0)[0] for t in tickets]
+    assert all(r.ok and r.version == "v1" for r in results)
+    # Batching actually happened (not 200 single-row batches).
+    assert sched.n_batches < 200
+    assert sched.n_requests == 200
+    # Values match a direct evaluation bit-for-bit.
+    ref = srv1.evaluate(thetas)
+    for i, r in enumerate(results):
+        assert np.array_equal(r.u, ref.u[i])
+        assert r.cost == float(ref.cost[i])
+    # The serve.eval heartbeat (satellite: obs_watch can alarm on
+    # serving stalls) carries the scheduler's queue/fill context.
+    evs = [rec for rec in o.sink.records
+           if rec.get("name") == "serve.eval"]
+    assert evs and all("queue_depth" in e and "batch_fill_frac" in e
+                       for e in evs)
+    snap = o.metrics.snapshot()
+    # Counters: per-controller namespaced + the cross-controller sum.
+    assert snap["counters"]["serve.ctl.c.requests"] == 200
+    assert snap["counters"]["serve.requests"] == 200
+    # Gauges live ONLY under the namespace (a second controller's
+    # scheduler must not overwrite them).
+    assert snap["gauges"]["serve.ctl.c.p99_us"] > 0
+    assert "serve.p99_us" not in snap["gauges"]
+    assert "serve.ctl.c.request_s" in snap["histograms"]
+    o.close()
+
+
+def test_scheduler_deadline_flush_single_query(servers):
+    """A lone query must not wait for the batch to fill: the deadline
+    budget bounds its queue time."""
+    srv1, _ = servers
+    reg = ControllerRegistry()
+    reg.publish("c", "v1", srv1)
+    with RequestScheduler(reg, "c", max_batch=256,
+                          max_wait_us=2000.0) as sched:
+        t0 = time.perf_counter()
+        (r,) = sched.submit(np.array([0.3, 0.4])).result(10.0)
+        wall = time.perf_counter() - t0
+    assert r.ok
+    assert wall < 5.0  # flushed on deadline, not on max_batch
+
+
+def test_scheduler_split_submission_and_close(servers, rng):
+    """A submission larger than max_batch spans micro-batches; close()
+    drains everything; submit-after-close raises."""
+    srv1, _ = servers
+    reg = ControllerRegistry()
+    reg.publish("c", "v1", srv1)
+    sched = RequestScheduler(reg, "c", max_batch=16, max_wait_us=500.0)
+    thetas = rng.uniform(0, 1, size=(70, 2))
+    t = sched.submit_batch(thetas)
+    sched.close()
+    results = t.result(1.0)
+    assert len(results) == 70 and all(r.ok for r in results)
+    ref = srv1.evaluate(thetas)
+    assert all(np.array_equal(results[i].u, ref.u[i]) for i in range(70))
+    with pytest.raises(RuntimeError):
+        sched.submit(thetas[0])
+
+
+def test_scheduler_validates_knobs(servers):
+    srv1, _ = servers
+    reg = ControllerRegistry()
+    reg.publish("c", "v1", srv1)
+    with pytest.raises(ValueError):
+        RequestScheduler(reg, "c", max_batch=48)
+    with pytest.raises(ValueError):
+        RequestScheduler(reg, "c", max_wait_us=0.0)
+
+
+def test_submit_rejects_wrong_width_without_poisoning_batch(servers,
+                                                            rng):
+    """A submission whose theta width does not match the controller
+    raises ON THE SUBMITTER; co-batched healthy requests from other
+    clients still serve (the bad rows never reach the worker's
+    np.concatenate)."""
+    srv1, _ = servers
+    reg = ControllerRegistry()
+    reg.publish("c", "v1", srv1)
+    with RequestScheduler(reg, "c", max_batch=32,
+                          max_wait_us=50_000.0) as sched:
+        good = sched.submit_batch(rng.uniform(0, 1, size=(3, 2)))
+        with pytest.raises(ValueError, match="parameter dim"):
+            sched.submit(np.array([0.1, 0.2, 0.3]))  # p=3 on a p=2 tree
+        with pytest.raises(ValueError, match=r"\(k, p\)"):
+            sched.submit_batch(np.zeros((2, 2, 2)))
+        results = good.result(30.0)
+    assert len(results) == 3 and all(r.ok for r in results)
+
+
+def test_publish_rejects_param_dim_change():
+    """The parameter width is a publish-enforced invariant of the
+    controller name: rows are width-validated at submit time, so a
+    mid-traffic width change would let already-validated queued rows
+    reach a later lease's evaluator and fail every co-batched ticket.
+    A different-width tree deploys under a NEW controller name."""
+    reg = ControllerRegistry()
+    reg.publish("c", "v1", _server(p=2))
+    assert reg.param_dim("c") == 2
+    with pytest.raises(ValueError, match="parameter dim 3"):
+        reg.publish("c", "v2", _server(p=3, depth=5))
+    assert reg.active_version("c") == "v1"  # rejected: nothing changed
+    assert reg.param_dim("c") == 2
+    # Same width republishes fine; a new name takes any width.
+    reg.publish("c", "v2", _server(p=2, depth=5))
+    reg.publish("c3", "v1", _server(p=3, depth=5))
+    assert reg.param_dim("c3") == 3
+
+
+def test_fallback_box_follows_leased_server():
+    """The clamp box is re-derived from the server the batch leased,
+    so a hot swap to a tree rebuilt on a WIDER box clamps to the new
+    certified boundary, not the boot-time one."""
+    tree, roots = build_synthetic_tree(p=2, depth=5, n_u=2,
+                                       lb=[0.0, 0.0], ub=[2.0, 2.0])
+    table = export.export_leaves(tree)
+    dt = descent.export_descent(tree, roots, table, stage=False)
+    srv2 = sharded.shard_descent(dt, table, n_shards=2)
+    # Policy constructed with the ORIGINAL (stale) box.
+    fb = FallbackPolicy(np.zeros(2), np.ones(2))
+    theta = np.array([[2.5, 0.5]])
+    res = srv2.evaluate(theta)
+    assert not res.inside[0]
+    patched, tags = fb.apply(theta, res, srv2)
+    assert tags == ["clamp"] and patched.inside[0]
+    # Clamped at srv2's box edge (2.0), not the stale 1.0.
+    ref = srv2.evaluate(np.array([[2.0, 0.5]]))
+    np.testing.assert_array_equal(patched.u, ref.u)
+    # A server without root_bary falls back to the constructor box.
+    lb, ub = fb._box(object())
+    np.testing.assert_array_equal(lb, np.zeros(2))
+    np.testing.assert_array_equal(ub, np.ones(2))
+
+
+def test_scheduler_flushes_metrics_during_serving(servers, rng,
+                                                  monkeypatch):
+    """The worker writes metrics snapshots into the stream WHILE
+    serving, so the serving health rules alarm live -- not only in the
+    close() post-mortem snapshot."""
+    from explicit_hybrid_mpc_tpu.serve import scheduler as sched_mod
+
+    monkeypatch.setattr(sched_mod, "METRICS_FLUSH_S", 0.0)
+    srv1, _ = servers
+    o = obs_lib.Obs("jsonl")
+    reg = ControllerRegistry(obs=o)
+    reg.publish("c", "v1", srv1)
+    sched = RequestScheduler(reg, "c", max_batch=8, max_wait_us=500.0,
+                             obs=o)
+    for t in [sched.submit(th) for th in rng.uniform(0, 1, (40, 2))]:
+        t.result(30.0)
+    # Snapshot records present BEFORE close, carrying the namespaced
+    # serving gauges a live HealthMonitor evaluates.
+    snaps = [r for r in o.sink.records if r.get("kind") == "metrics"]
+    assert snaps
+    assert any("serve.ctl.c.p99_us" in (s.get("gauges") or {})
+               for s in snaps)
+    mon = HealthMonitor(rules={"serve_p99_us": 1e-6,
+                               "min_solves_for_rates": 1.0})
+    for rec in o.sink.records:
+        mon.feed(rec)
+    assert any(e["name"] == "health.serve_p99_us" for e in mon.events)
+    sched.close()
+    o.close()
+
+
+# -- registry / hot swap -----------------------------------------------------
+
+
+def test_registry_publish_lease_retire(servers):
+    srv1, srv2 = servers
+    o = obs_lib.Obs("jsonl")
+    reg = ControllerRegistry(obs=o)
+    with pytest.raises(KeyError):
+        with reg.lease("nope"):
+            pass
+    v1 = reg.publish("c", "v1", srv1)
+    assert reg.active_version("c") == "v1"
+    with reg.lease("c") as ver:
+        assert ver is v1
+        # Swap while leased: v1 retires only after the lease releases.
+        reg.publish("c", "v2", srv2)
+        assert v1.state == "retiring"
+        assert reg.active_version("c") == "v2"
+    assert reg.wait_retired(v1, 5.0)
+    names = [r["name"] for r in o.sink.records]
+    assert names.count("serve.swap") == 2  # initial publish + swap
+    assert "serve.retired" in names
+    swap = [r for r in o.sink.records if r["name"] == "serve.swap"][-1]
+    assert swap["from_version"] == "v1" and swap["to_version"] == "v2"
+    o.close()
+
+
+def test_hot_swap_atomicity_under_concurrent_submits(servers):
+    """Satellite acceptance: submitters racing a hot swap observe ONLY
+    complete-old-version or complete-new-version results -- never a
+    torn read -- and zero requests are dropped.  v2 payloads are
+    exactly 2x v1's, so any cross-version mix inside one result is a
+    bitwise mismatch against both references."""
+    srv1, srv2 = servers
+    reg = ControllerRegistry()
+    v1 = reg.publish("c", "v1", srv1)
+    sched = RequestScheduler(reg, "c", max_batch=32, max_wait_us=800.0)
+    stop = threading.Event()
+    errors, outs = [], []
+    lock = threading.Lock()
+
+    def client(cid):
+        r = np.random.default_rng(cid)
+        while not stop.is_set():
+            th = r.uniform(0, 1, size=(3, 2))  # small-batch submission
+            try:
+                res = sched.submit_batch(th).result(10.0)
+            except Exception as e:  # noqa: BLE001 -- a drop IS a failure
+                errors.append(e)
+                return
+            with lock:
+                outs.extend(zip(th, res))
+
+    threads = [threading.Thread(target=client, args=(c,))
+               for c in range(6)]
+    for t in threads:
+        t.start()
+    time.sleep(0.3)
+    reg.publish("c", "v2", srv2)
+    time.sleep(0.3)
+    stop.set()
+    for t in threads:
+        t.join()
+    sched.close()
+    assert not errors  # zero dropped requests across the swap
+    assert reg.wait_retired(v1, 5.0)  # two-epoch handoff drained
+    seen = {r.version for _t, r in outs}
+    assert seen == {"v1", "v2"}  # traffic actually straddled the swap
+    thetas = np.stack([t for t, _r in outs])
+    ref = srv1.evaluate(thetas)
+    for k, (_t, r) in enumerate(outs):
+        scale = 1.0 if r.version == "v1" else 2.0
+        assert np.array_equal(r.u, scale * ref.u[k]), (k, r.version)
+        assert r.cost == scale * float(ref.cost[k])
+
+
+# -- fallback ----------------------------------------------------------------
+
+
+def test_fallback_clamp_outside_box(servers):
+    srv1, _ = servers
+    o = obs_lib.Obs("jsonl")
+    lb, ub = root_box(srv1)
+    np.testing.assert_allclose(lb, 0.0, atol=1e-12)
+    np.testing.assert_allclose(ub, 1.0, atol=1e-12)
+    fb = FallbackPolicy(lb, ub, obs=o)
+    thetas = np.array([[0.5, 0.5], [1.4, 0.5], [-0.2, 2.0]])
+    res = srv1.evaluate(thetas)
+    assert list(res.inside) == [True, False, False]
+    patched, tags = fb.apply(thetas, res, srv1)
+    assert tags == [None, "clamp", "clamp"]
+    assert patched.inside.all()
+    # Clamped rows carry the law of the nearest certified leaf,
+    # evaluated at the clamped coordinate.
+    ref = srv1.evaluate(np.clip(thetas, lb, ub))
+    np.testing.assert_array_equal(patched.u, ref.u)
+    c = o.metrics.snapshot()["counters"]
+    assert c["serve.fallback.outside_box"] == 2
+    assert c["serve.fallback.clamp"] == 2
+    assert c["serve.fallback.requests"] == 2
+    assert c.get("serve.fallback.hole", 0) == 0
+    o.close()
+
+
+class _FakeSol(NamedTuple):
+    dstar: np.ndarray
+    u0: np.ndarray
+    Vstar: np.ndarray
+
+
+class _FakeOracle:
+    """Minimal solve_vertices stand-in: one commutation, u = 7*theta."""
+
+    def __init__(self):
+        self.n_calls = 0
+
+    def solve_vertices(self, thetas):
+        self.n_calls += thetas.shape[0]
+        K = thetas.shape[0]
+        u0 = 7.0 * thetas[:, None, :]
+        return _FakeSol(dstar=np.zeros(K, dtype=np.int64), u0=u0,
+                        Vstar=thetas.sum(axis=1))
+
+
+def test_fallback_hole_routes_to_budgeted_oracle():
+    """In-box queries landing on a payload-free (hole) leaf cannot be
+    clamp-served; the oracle path answers a bounded fraction and the
+    rest are counted unserved."""
+    from explicit_hybrid_mpc_tpu.partition import geometry
+    from explicit_hybrid_mpc_tpu.partition.tree import LeafData, Tree
+
+    t = Tree(p=1, n_u=1)
+    r = t.add_root(np.array([[0.0], [1.0]]))
+    left, right, i, j, _ = geometry.bisect(t.vertices[r])
+    li, ri = t.split(r, left, right, (i, j))
+    t.set_leaf(li, LeafData(delta_idx=0, vertex_inputs=np.ones((2, 1)),
+                            vertex_costs=np.zeros(2)))
+    table = export.export_leaves(t)
+    dt = descent.export_descent(t, [r], table, stage=False)
+    srv = sharded.shard_descent(dt, table, n_shards=2, granularity=1)
+    o = obs_lib.Obs("jsonl")
+    oracle = _FakeOracle()
+    fb = FallbackPolicy(np.zeros(1), np.ones(1), oracle=oracle,
+                        max_oracle_frac=0.5, obs=o)
+    # 4 requests: 2 in the certified half, 2 in the hole; budget
+    # 0.5 * 4 = 2 oracle solves -> both holes served this round.
+    thetas = np.array([[0.25], [0.30], [0.75], [0.80]])
+    res = srv.evaluate(thetas)
+    patched, tags = fb.apply(thetas, res, srv)
+    assert tags[:2] == [None, None]
+    assert tags[2:] == ["oracle", "oracle"]
+    np.testing.assert_allclose(patched.u[2:], 7.0 * thetas[2:])
+    assert patched.inside.all()
+    c = o.metrics.snapshot()["counters"]
+    assert c["serve.fallback.hole"] == 2
+    assert c["serve.fallback.oracle"] == 2
+    # Budget exhausted: the next hole burst degrades to unserved.
+    thetas2 = np.array([[0.9], [0.95], [0.85]])
+    res2 = srv.evaluate(thetas2)
+    patched2, tags2 = fb.apply(thetas2, res2, srv)
+    assert tags2.count("unserved") >= 2  # budget allows at most 1 more
+    assert oracle.n_calls <= int(0.5 * fb.n_seen)
+    o.close()
+
+
+class _MissOracle:
+    """solve_vertices stand-in that finds NO valid commutation."""
+
+    def solve_vertices(self, thetas):
+        K = thetas.shape[0]
+        return _FakeSol(dstar=np.full(K, -1, dtype=np.int64),
+                        u0=np.full((K, 1, thetas.shape[1]), np.nan),
+                        Vstar=np.full(K, np.inf))
+
+
+def test_fallback_oracle_miss_leaves_row_untouched():
+    """'unserved' means UNTOUCHED: an oracle miss (dstar=-1) must not
+    overwrite the raw row with an invalid commutation's u and a +inf
+    cost (which would also break strict-JSON result consumers)."""
+    from explicit_hybrid_mpc_tpu.partition import geometry
+    from explicit_hybrid_mpc_tpu.partition.tree import LeafData, Tree
+
+    t = Tree(p=1, n_u=1)
+    r = t.add_root(np.array([[0.0], [1.0]]))
+    left, right, i, j, _ = geometry.bisect(t.vertices[r])
+    li, ri = t.split(r, left, right, (i, j))
+    t.set_leaf(li, LeafData(delta_idx=0, vertex_inputs=np.ones((2, 1)),
+                            vertex_costs=np.zeros(2)))
+    table = export.export_leaves(t)
+    dt = descent.export_descent(t, [r], table, stage=False)
+    srv = sharded.shard_descent(dt, table, n_shards=2, granularity=1)
+    fb = FallbackPolicy(np.zeros(1), np.ones(1), oracle=_MissOracle(),
+                        max_oracle_frac=1.0)
+    thetas = np.array([[0.75]])  # the hole half
+    res = srv.evaluate(thetas)
+    assert not res.inside[0]
+    patched, tags = fb.apply(thetas, res, srv)
+    assert tags == ["unserved"]
+    np.testing.assert_array_equal(patched.u, res.u)  # raw, not NaN
+    np.testing.assert_array_equal(patched.cost, res.cost)  # not +inf
+    assert not patched.inside[0]
+
+
+def test_fallback_off_mode_passthrough(servers):
+    srv1, _ = servers
+    fb = FallbackPolicy(np.zeros(2), np.ones(2), mode="off")
+    thetas = np.array([[2.0, 2.0]])
+    res = srv1.evaluate(thetas)
+    patched, tags = fb.apply(thetas, res, srv1)
+    assert tags == [None] and not patched.inside[0]
+    with pytest.raises(ValueError):
+        FallbackPolicy(np.zeros(2), np.ones(2), mode="nearest")
+
+
+# -- oversized batches (online/sharded.py satellite) -------------------------
+
+
+def test_oversized_batch_splits_and_reports(rng):
+    """A batch beyond max_bucket is split to the max bucket -- results
+    identical to the uncapped server -- and a health.oversized_batch
+    event (adopted by HealthMonitor) replaces the silent fresh
+    compile."""
+    o = obs_lib.Obs("jsonl")
+    srv_cap = _server(obs=o, max_bucket=16)
+    srv_ref = _server()
+    thetas = rng.uniform(0, 1, size=(70, 2))
+    out = srv_cap.evaluate(thetas)
+    ref = srv_ref.evaluate(thetas)
+    np.testing.assert_array_equal(out.u, ref.u)
+    np.testing.assert_array_equal(out.leaf, ref.leaf)
+    np.testing.assert_array_equal(out.inside, ref.inside)
+    rows_c, nodes_c = srv_cap.locate(thetas)
+    rows_r, nodes_r = srv_ref.locate(thetas)
+    np.testing.assert_array_equal(rows_c, rows_r)
+    np.testing.assert_array_equal(nodes_c, nodes_r)
+    evs = [r for r in o.sink.records
+           if r.get("name") == "health.oversized_batch"]
+    assert len(evs) == 2  # one per oversized call (evaluate + locate)
+    assert evs[0]["severity"] == "warn"
+    assert evs[0]["value"] == 70 and evs[0]["threshold"] == 16
+    assert o.metrics.snapshot()["counters"][
+        "serve.oversized_batches"] == 2
+    # The monitor adopts the event: an external tailer exits nonzero.
+    mon = HealthMonitor()
+    for rec in o.sink.records:
+        mon.feed(rec)
+    assert mon.worst == "warn"
+    with pytest.raises(ValueError):
+        _server(max_bucket=24)  # non-pow-2 cap rejected
+    o.close()
+
+
+# -- serving health rules ----------------------------------------------------
+
+
+def test_health_serve_rules_fire_on_gauges():
+    mon = HealthMonitor(rules={"serve_p99_us": 5000.0,
+                               "fallback_frac": 0.1,
+                               "min_solves_for_rates": 10.0})
+    rec = {"kind": "metrics", "name": "snapshot",
+           "counters": {"serve.requests": 50},
+           "gauges": {"serve.p99_us": 9000.0,
+                      "serve.fallback_frac": 0.3}}
+    evs = mon.feed(rec)
+    names = {e["name"] for e in evs}
+    assert names == {"health.serve_p99_us", "health.fallback_frac"}
+    assert mon.worst == "warn"
+    # Volume-gated: the same gauges under min volume stay silent.
+    mon2 = HealthMonitor(rules={"serve_p99_us": 5000.0,
+                                "fallback_frac": 0.1,
+                                "min_solves_for_rates": 100.0})
+    assert mon2.feed(rec) == []
+    # Disabled (0) thresholds never fire.
+    mon3 = HealthMonitor(rules={"serve_p99_us": 0.0,
+                                "fallback_frac": 0.0,
+                                "min_solves_for_rates": 10.0})
+    assert mon3.feed(rec) == []
+
+
+def test_health_serve_rules_per_controller_no_masking():
+    """Two controllers on one obs handle: the healthy one's gauges must
+    not mask the breaching one's (the scheduler namespaces its gauges
+    serve.ctl.<name>.*; the rules scan every controller and volume-gate
+    each on ITS OWN request counter)."""
+    mon = HealthMonitor(rules={"serve_p99_us": 5000.0,
+                               "fallback_frac": 0.1,
+                               "min_solves_for_rates": 10.0})
+    rec = {"kind": "metrics", "name": "snapshot",
+           "counters": {"serve.ctl.good.requests": 500,
+                        "serve.ctl.bad.requests": 500,
+                        "serve.ctl.tiny.requests": 3},
+           "gauges": {"serve.ctl.good.p99_us": 800.0,
+                      "serve.ctl.good.fallback_frac": 0.01,
+                      "serve.ctl.bad.p99_us": 50_000.0,
+                      "serve.ctl.bad.fallback_frac": 0.4,
+                      # Breaching gauges but 3 requests: volume-gated.
+                      "serve.ctl.tiny.p99_us": 90_000.0}}
+    evs = mon.feed(rec)
+    assert {e["name"] for e in evs} == {"health.serve_p99_us",
+                                        "health.fallback_frac"}
+    assert all("'bad'" in e["msg"] for e in evs)
+    # A second breaching controller fires its own event -- the first
+    # one's cooldown is per-controller, not per-rule.
+    rec2 = {"kind": "metrics", "name": "snapshot",
+            "counters": {"serve.ctl.bad.requests": 600,
+                         "serve.ctl.worse.requests": 600},
+            "gauges": {"serve.ctl.bad.p99_us": 50_000.0,
+                       "serve.ctl.worse.p99_us": 70_000.0}}
+    evs2 = mon.feed(rec2)
+    assert [e for e in evs2 if "'worse'" in e["msg"]]
+    assert not [e for e in evs2 if "'bad'" in e["msg"]]  # cooling down
+
+
+def test_serve_config_validation():
+    ServeConfig()  # defaults valid
+    with pytest.raises(ValueError):
+        ServeConfig(max_batch=100)
+    with pytest.raises(ValueError):
+        ServeConfig(max_bucket=128, max_batch=256)
+    # max_bucket unset still validates against the EVALUATOR default:
+    # a max_batch above it would make every full micro-batch split.
+    from explicit_hybrid_mpc_tpu.config import DEFAULT_MAX_BUCKET
+    with pytest.raises(ValueError, match="effective"):
+        ServeConfig(max_batch=DEFAULT_MAX_BUCKET * 2)
+    ServeConfig(max_batch=DEFAULT_MAX_BUCKET)  # at the cap: valid
+    with pytest.raises(ValueError):
+        ServeConfig(max_wait_us=0)
+    with pytest.raises(ValueError):
+        ServeConfig(fallback="nearest")
+    with pytest.raises(ValueError):
+        ServeConfig(max_oracle_frac=1.5)
+    with pytest.raises(ValueError):
+        ServeConfig(obs="loud")
+
+
+# -- serve_bench + CLI -------------------------------------------------------
+
+
+def test_serve_bench_sweep_with_hot_swap(tmp_path, monkeypatch):
+    """Acceptance: the closed-loop sweep reports p99, sustains
+    batch-fill >= 0.5 at the top offered rate, and the mid-run hot
+    swap drops zero requests with bit-identical per-version results;
+    the condensed serve_* row lands in the (test-scoped) history."""
+    monkeypatch.setenv("SERVE_BENCH_SECS", "0.5")
+    monkeypatch.setenv("SERVE_BENCH_DEPTH", "5")
+    monkeypatch.setenv("SERVE_BENCH_RATES", "800,6000")
+    monkeypatch.setenv("SERVE_BENCH_MAX_BATCH", "32")
+    hist = tmp_path / "hist.jsonl"
+    monkeypatch.setenv("BENCH_HISTORY", str(hist))
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    try:
+        import bench_gate
+        import serve_bench
+
+        result = serve_bench.run(out_path=str(tmp_path / "serve.json"))
+        assert result["serve_p99_us"] is not None
+        assert result["serve_batch_fill"] >= 0.5
+        assert result["swap_dropped"] == 0
+        assert result["swap_torn"] == 0
+        assert result["swap_drained"] is True
+        assert result["versions_seen"] == ["v1", "v2"]
+        assert all(r["p99_us"] is not None for r in result["rates"])
+        rows = bench_gate.load_history(str(hist))
+        assert len(rows) == 1
+        row = rows[0]
+        # Serve rows gate their own metric family: serve_* present,
+        # the build-family headline "value" absent (window isolation).
+        assert row["serve_p99_us"] == result["serve_p99_us"]
+        assert row["fallback_frac"] == result["fallback_frac"]
+        assert row["value"] is None
+        assert row["swap_dropped"] == 0
+        flags, _info = bench_gate.gate(row, rows)
+        assert flags == []  # candidate vs itself-excluded base: clean
+    finally:
+        sys.path.pop(0)
+
+
+def test_serve_cli_selftest(tmp_path, capsys):
+    """main.py `serve` subcommand: deploy from saved artifacts, run the
+    selftest loop, emit one JSON summary."""
+    from explicit_hybrid_mpc_tpu.main import main as cli_main
+
+    tree, roots = build_synthetic_tree(p=2, depth=5, n_u=1)
+    d = str(tmp_path / "artifacts")
+    save_artifacts(tree, roots, d)
+    rc = cli_main(["serve", "--artifacts", d, "--controller", "t",
+                   "--shards", "2", "--max-batch", "16",
+                   "--selftest", "64"])
+    assert rc == 0
+    summ = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert summ["requests"] == 64
+    assert summ["controller"] == "t" and summ["version"] == "v1"
+    assert summ["p99_us"] is not None
+    assert summ["fallback_served"] > 0  # the outside band was exercised

@@ -46,7 +46,12 @@ BASELINE_VERSION = 1
 SEVERITIES = ("error", "warn")
 
 #: wrappers whose FIRST argument (or decorated function) is traced.
-_JIT_WRAPPERS = {"jit", "vmap", "pmap", "shard_map", "named_call"}
+#: pallas_call: a Pallas kernel body is traced (then Mosaic-lowered or
+#: interpret-executed) exactly like a jitted function, so host-sync /
+#: dtype / obs-in-hot-loop rules must cover kernel bodies too
+#: (oracle/pallas_ipm.py, online/pallas_eval.py).
+_JIT_WRAPPERS = {"jit", "vmap", "pmap", "shard_map", "named_call",
+                 "pallas_call"}
 #: control-flow combinators -> indices of their traced function args.
 _BODY_WRAPPERS = {
     "fori_loop": (2,),

@@ -111,6 +111,16 @@ class PartitionConfig:
     # class only; None preserves the shared value / auto 2/5 split.
     ipm_phase1_iters_point: Optional[int] = None
     ipm_phase1_iters_simplex: Optional[int] = None
+    # IPM kernel dispatch tier (oracle/pallas_ipm.py): 'auto' probes
+    # the backend (TPU -> the fused Pallas VMEM micro-kernel that runs
+    # a whole fixed-iteration predictor-corrector leg per launch; CPU
+    # -> the XLA reference path), 'pallas' forces the kernel (interpret
+    # mode off-TPU -- the parity-test configuration), 'xla' forces the
+    # reference.  Tier-independent semantics: schedules, cohort splits,
+    # and warm-start gating are shared code; only per-iteration
+    # arithmetic ordering differs (last-ulp).  docs/perf.md "IPM
+    # kernel".
+    ipm_kernel: str = "auto"
     # Tree warm-starts (Oracle(warm_start=...)): cache the oracle's
     # final duals/slacks per vertex row and feed a cached sibling
     # vertex's iterates as the IPM start for new bisection midpoints,
@@ -274,6 +284,9 @@ class PartitionConfig:
                 raise ValueError(f"{fld} must be >= 1 (or None to "
                                  "inherit ipm_phase1_iters / the auto "
                                  "split)")
+        if self.ipm_kernel not in ("auto", "pallas", "xla"):
+            raise ValueError(f"unknown ipm_kernel {self.ipm_kernel!r} "
+                             "(expected 'auto', 'pallas', or 'xla')")
         if self.pipeline_depth < 0:
             raise ValueError("pipeline_depth must be >= 0 "
                              "(0 = synchronous build)")

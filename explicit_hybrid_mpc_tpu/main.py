@@ -77,6 +77,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--no-warm-start", action="store_true",
                    help="disable tree warm-starts (cold-start every "
                    "child-vertex QP)")
+    p.add_argument("--ipm-kernel", choices=("auto", "pallas", "xla"),
+                   default="auto",
+                   help="IPM dispatch tier (oracle/pallas_ipm.py): "
+                   "'auto' probes the backend (TPU -> fused Pallas "
+                   "VMEM micro-kernel, CPU -> XLA reference); "
+                   "'pallas'/'xla' force a tier (pallas runs in "
+                   "interpret mode off-TPU)")
     p.add_argument("--pipeline-depth", type=int, default=None,
                    metavar="N",
                    help="frontier batches planned + dispatched ahead of "
@@ -248,6 +255,7 @@ def main(argv: list[str] | None = None) -> int:
         ipm_phase1_iters_point=args.phase1_iters_point,
         ipm_phase1_iters_simplex=args.phase1_iters_simplex,
         warm_start_tree=not args.no_warm_start,
+        ipm_kernel=args.ipm_kernel,
         **({"pipeline_depth": args.pipeline_depth}
            if args.pipeline_depth is not None else {}),
         speculate=not args.no_speculate,
@@ -303,7 +311,10 @@ def main(argv: list[str] | None = None) -> int:
                             ("ipm_phase1_iters", None),
                             ("ipm_phase1_iters_point", None),
                             ("ipm_phase1_iters_simplex", None),
-                            ("warm_start_tree", False)):
+                            ("warm_start_tree", False),
+                            # Pre-tier snapshots keep the XLA path
+                            # mid-build (resumed-equals-straight).
+                            ("ipm_kernel", "xla")):
             if fld not in snap_cfg.__dict__:
                 object.__setattr__(snap_cfg, fld, legacy)
         for fld in ("problem", "problem_args", "eps_a", "eps_r",
@@ -311,7 +322,7 @@ def main(argv: list[str] | None = None) -> int:
                     "ipm_point_schedule", "ipm_rescue_iters",
                     "ipm_two_phase", "ipm_phase1_iters",
                     "ipm_phase1_iters_point", "ipm_phase1_iters_simplex",
-                    "warm_start_tree",
+                    "warm_start_tree", "ipm_kernel",
                     "batch_simplices", "max_depth",
                     "semi_explicit_boundary_depth", "prune_rows"):
             cli_v = getattr(cfg, fld)

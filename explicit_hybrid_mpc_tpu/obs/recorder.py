@@ -93,6 +93,10 @@ def oracle_meta(oracle) -> dict:
         "point_n_iter": oracle.point_n_iter,
         "simplex_n_f32": oracle.n_f32,
         "simplex_n_iter": oracle.n_iter,
+        # Resolved IPM dispatch tier (oracle/pallas_ipm.py): replay
+        # rebuilds the oracle on the same tier; pre-tier bundles
+        # default to the XLA reference path.
+        "ipm_kernel": getattr(oracle, "ipm_kernel", "xla"),
     }
 
 

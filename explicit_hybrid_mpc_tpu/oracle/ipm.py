@@ -72,15 +72,24 @@ def _fraction_to_boundary(v: jax.Array, dv: jax.Array, tau: float) -> jax.Array:
     return jnp.minimum(1.0, tau * jnp.min(ratio, axis=-1))
 
 
+def leg_constants(dtype) -> tuple[float, float]:
+    """(cholesky ridge, slack/dual floor) for one precision leg --
+    shared by _make_body AND the fused Pallas kernel
+    (oracle/pallas_ipm.py), so a ridge tuning can never make the
+    dispatch tiers silently diverge.  f32 factorizations need a
+    heavier ridge than f64 to survive the terminal D = lam/s blow-up."""
+    if dtype == jnp.float32:
+        return 1e-7, 1e-8
+    return 1e-10, _TINY
+
+
 def _make_body(Q, q, A, b):
     """One Mehrotra predictor-corrector step in the arrays' dtype."""
     nz = Q.shape[-1]
     nc = A.shape[-2]
     dtype = Q.dtype
-    # f32 factorizations need a heavier ridge than f64 to survive the
-    # terminal D = lam/s blow-up.
-    reg = jnp.asarray(1e-10 if dtype == jnp.float64 else 1e-7, dtype)
-    tiny = _TINY if dtype == jnp.float64 else 1e-8
+    reg_f, tiny = leg_constants(dtype)
+    reg = jnp.asarray(reg_f, dtype)
 
     def body(_, carry):
         z, s, lam = carry
@@ -120,6 +129,40 @@ def _make_body(Q, q, A, b):
     return body
 
 
+def _run_leg(Q, q, A, b, start, n_iter: int, kernel: str):
+    """One fixed-iteration Mehrotra leg under the selected kernel tier.
+
+    kernel='xla' (the semantic reference): the fori_loop over
+    `_make_body` -- each iteration a chain of generic batched XLA ops.
+    kernel='pallas': the fused VMEM micro-kernel (oracle/pallas_ipm.py)
+    -- the whole leg is one kernel launch per batch tile, dispatched
+    through custom_vmap so batched callers hit the tiled kernel and
+    unbatched callers keep the reference body.
+    kernel='pallas:interpret': same, with interpret mode FORCED --
+    required when the programs are placed on a non-default device
+    (a backend='cpu' oracle, or the device-failure cpu_twin, on a TPU
+    host: the process default backend says 'tpu' but these programs
+    execute on CPU, where only interpret mode is valid; Oracle
+    resolves this from its own device's platform).
+    Guard: Mosaic has no f64, so on a REAL TPU lowering (no interpret
+    mode) a non-f32 leg stays on the XLA path, which emulates f64 as
+    before; interpret mode runs any dtype through the kernel -- the
+    parity-test surface.  Iteration counts are identical across tiers
+    by construction (`schedule_iters` stays exact)."""
+    if n_iter <= 0:
+        return start
+    if kernel.startswith("pallas"):
+        from explicit_hybrid_mpc_tpu.oracle import pallas_ipm
+
+        interpret = (kernel == "pallas:interpret"
+                     or pallas_ipm.interpret_mode())
+        if Q.dtype == jnp.float32 or interpret:
+            return pallas_ipm.mehrotra_leg(
+                n_iter, interpret=interpret)(Q, q, A, b, *start)
+    body = _make_body(Q, q, A, b)
+    return jax.lax.fori_loop(0, n_iter, body, start)
+
+
 def schedule_iters(n_f32: int, n_f64: int) -> int:
     """Mehrotra iterations one QP spends under an (n_f32, n_f64)
     schedule.  The kernel is fixed-iteration by design -- no early exit
@@ -138,10 +181,21 @@ def schedule_iters(n_f32: int, n_f64: int) -> int:
 def qp_solve(Q: jax.Array, q: jax.Array, A: jax.Array, b: jax.Array,
              n_iter: int = 30, tol: float = 1e-8,
              n_f32: int = 0,
-             warm_start: tuple | None = None) -> QPSolution:
+             warm_start: tuple | None = None,
+             kernel: str = "xla") -> QPSolution:
     """Solve one dense convex QP with Mehrotra predictor-corrector.
 
     Shapes: Q (nz,nz) PD, q (nz,), A (nc,nz), b (nc,).  vmap freely.
+
+    kernel: 'xla' (default, the semantic reference) runs each
+    precision leg as the fori_loop over generic batched XLA ops;
+    'pallas' routes batched legs through the fused VMEM micro-kernel
+    (oracle/pallas_ipm.py; see _run_leg for the exact dispatch and
+    its f64-on-TPU fallback).  Everything OUTSIDE the legs --
+    equilibration, the warm-start merit gate, residual classification
+    -- is shared, so the tiers differ only in per-iteration arithmetic
+    ordering (last-ulp) and report identical schedules.  Callers pick
+    the tier via Oracle(ipm_kernel=...) / cfg.ipm_kernel.
 
     warm_start, when given, is a ``(z0, s0, lam0, valid)`` tuple in
     ORIGINAL (unequilibrated) units -- e.g. a neighbouring vertex's
@@ -241,18 +295,17 @@ def qp_solve(Q: jax.Array, q: jax.Array, A: jax.Array, b: jax.Array,
     if n_f32 > 0:
         f32 = jnp.float32
         with jax.default_matmul_precision("highest"):
-            body32 = _make_body(Q.astype(f32), q.astype(f32),
-                                A.astype(f32), b.astype(f32))
-            warm32 = jax.lax.fori_loop(
-                0, n_f32, body32, tuple(c.astype(f32) for c in start))
+            warm32 = _run_leg(Q.astype(f32), q.astype(f32),
+                              A.astype(f32), b.astype(f32),
+                              tuple(c.astype(f32) for c in start),
+                              n_f32, kernel)
         warm = tuple(c.astype(dtype) for c in warm32)
         m_warm = merit(warm)
         ok = jnp.isfinite(m_warm) & (m_warm <= merit(start))
         f32_ok = ok
         start = tuple(jnp.where(ok, w, c) for w, c in zip(warm, start))
 
-    body = _make_body(Q, q, A, b)
-    z, s, lam = jax.lax.fori_loop(0, n_iter, body, start)
+    z, s, lam = _run_leg(Q, q, A, b, start, n_iter, kernel)
 
     # Back to original units for the returned solution and the KKT
     # residual checks (tolerances must mean what callers think they mean).
@@ -277,7 +330,7 @@ def qp_solve(Q: jax.Array, q: jax.Array, A: jax.Array, b: jax.Array,
 
 
 def solve_mask(Q, q, A, b, n_iter: int = 30, n_f32: int = 0,
-               tol: float = 1e-8):
+               tol: float = 1e-8, kernel: str = "xla"):
     """Batched host-level convergence probe: run qp_solve over a batch
     of raw QPs and return numpy ``(converged, feasible, rp)``.
 
@@ -290,31 +343,39 @@ def solve_mask(Q, q, A, b, n_iter: int = 30, n_f32: int = 0,
     attributed to the kernel or to the pipeline around it.
 
     Shapes: Q (K, nz, nz), q (K, nz), A (K, nc, nz), b (K, nc).
+
+    kernel: dispatch tier for the probe ('xla' default; 'pallas' runs
+    the fused micro-kernel -- scripts/replay_solve.py --kernel-tier
+    threads this so a bundle can be replayed through either tier).
     """
     import numpy as np
 
-    sol = _mask_solver(int(n_iter), int(n_f32), float(tol))(
+    sol = _mask_solver(int(n_iter), int(n_f32), float(tol), str(kernel))(
         jnp.asarray(Q), jnp.asarray(q), jnp.asarray(A), jnp.asarray(b))
     return (np.asarray(sol.converged), np.asarray(sol.feasible),
             np.asarray(sol.rp))
 
 
 @functools.lru_cache(maxsize=32)
-def _mask_solver(n_iter: int, n_f32: int, tol: float):
+def _mask_solver(n_iter: int, n_f32: int, tol: float, kernel: str = "xla"):
     """Jitted batch solver behind solve_mask, cached per schedule.
 
     Building the jax.jit wrapper inside solve_mask itself minted a
     fresh compiled callable -- and an empty jit cache -- per CALL, so
     every replay probe recompiled the whole vmapped kernel (found by
-    tpulint's recompile-hazard rule).  The cache key is the schedule;
-    jit's own cache handles the batch shapes."""
+    tpulint's recompile-hazard rule).  The cache key is the schedule
+    plus the kernel tier (tol is a FLOAT key: nearby-but-distinct
+    tolerances must mint distinct solvers -- tests/test_ipm.py pins
+    this); jit's own cache handles the batch shapes."""
     return jax.jit(jax.vmap(
         lambda Qk, qk, Ak, bk: qp_solve(Qk, qk, Ak, bk, n_iter=n_iter,
-                                        tol=tol, n_f32=n_f32)))
+                                        tol=tol, n_f32=n_f32,
+                                        kernel=kernel)))
 
 
 def phase1(A: jax.Array, b: jax.Array, n_iter: int = 30,
-           rho: float = 1e-4, n_f32: int = 0) -> jax.Array:
+           rho: float = 1e-4, n_f32: int = 0,
+           kernel: str = "xla") -> jax.Array:
     """Minimal constraint violation t* = min max(A z - b) (smoothed).
 
     Solves min_z,t 1/2 rho t^2 + t  s.t.  A z - t <= b, a strictly feasible
@@ -331,5 +392,5 @@ def phase1(A: jax.Array, b: jax.Array, n_iter: int = 30,
     Q = Q.at[nz, nz].set(rho)
     q = jnp.zeros(nz + 1, dtype=dtype).at[nz].set(1.0)
     At = jnp.concatenate([A, -jnp.ones((nc, 1), dtype=dtype)], axis=1)
-    sol = qp_solve(Q, q, At, b, n_iter=n_iter, n_f32=n_f32)
+    sol = qp_solve(Q, q, At, b, n_iter=n_iter, n_f32=n_f32, kernel=kernel)
     return sol.z[nz]

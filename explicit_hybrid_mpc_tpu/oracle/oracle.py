@@ -103,13 +103,13 @@ class VertexSolution(NamedTuple):
 
 
 def _solve_one(prob: DeviceProblem, theta: jax.Array, d: int, n_iter: int,
-               n_f32: int = 0):
+               n_f32: int = 0, kernel: str = "xla"):
     """Fixed-commutation QP at one point: P_theta_delta in reference terms
     (SURVEY.md section 3, UNVERIFIED naming)."""
     q = prob.f[d] + prob.F[d] @ theta
     b = prob.w[d] + prob.S[d] @ theta
     sol = ipm.qp_solve(prob.H[d], q, prob.G[d], b, n_iter=n_iter,
-                       n_f32=n_f32)
+                       n_f32=n_f32, kernel=kernel)
     theta_cost = (0.5 * theta @ prob.Y[d] @ theta + prob.pvec[d] @ theta
                   + prob.cconst[d])
     V = sol.obj + theta_cost
@@ -123,7 +123,8 @@ def _solve_one(prob: DeviceProblem, theta: jax.Array, d: int, n_iter: int,
 
 
 def _solve_one_full(prob: DeviceProblem, theta: jax.Array, d,
-                    n_iter: int, n_f32: int = 0, warm=None):
+                    n_iter: int, n_f32: int = 0, warm=None,
+                    kernel: str = "xla"):
     """_solve_one plus the final duals/slacks and the warm-start accept
     flag -- the wire format of the two-phase cohort and tree-warm-start
     programs.  `warm` is an optional (z0, s0, lam0, valid) tuple in
@@ -131,7 +132,7 @@ def _solve_one_full(prob: DeviceProblem, theta: jax.Array, d,
     q = prob.f[d] + prob.F[d] @ theta
     b = prob.w[d] + prob.S[d] @ theta
     sol = ipm.qp_solve(prob.H[d], q, prob.G[d], b, n_iter=n_iter,
-                       n_f32=n_f32, warm_start=warm)
+                       n_f32=n_f32, warm_start=warm, kernel=kernel)
     theta_cost = (0.5 * theta @ prob.Y[d] @ theta + prob.pvec[d] @ theta
                   + prob.cconst[d])
     V = sol.obj + theta_cost
@@ -143,7 +144,7 @@ def _solve_one_full(prob: DeviceProblem, theta: jax.Array, d,
 
 
 def _solve_points_grid(prob: DeviceProblem, thetas: jax.Array, n_iter: int,
-                       n_f32: int = 0):
+                       n_f32: int = 0, kernel: str = "xla"):
     """(P points) x (nd commutations) raw grid solve, no reduction.
 
     The delta reduction is split out so parallel/mesh.py can shard the grid
@@ -155,7 +156,7 @@ def _solve_points_grid(prob: DeviceProblem, thetas: jax.Array, n_iter: int,
     def per_point(theta):
         return jax.vmap(
             lambda d: _solve_one(prob, theta, d, n_iter,
-                                 n_f32))(jnp.arange(nd))
+                                 n_f32, kernel))(jnp.arange(nd))
 
     return jax.vmap(per_point)(thetas)
 
@@ -173,16 +174,18 @@ def reduce_deltas(V: jax.Array, conv: jax.Array):
 
 
 def _solve_points_all_deltas(prob: DeviceProblem, thetas: jax.Array,
-                             n_iter: int, n_f32: int = 0):
+                             n_iter: int, n_f32: int = 0,
+                             kernel: str = "xla"):
     """(P points) x (nd commutations) in one vmapped program."""
     V, conv, feas, grad, u0, z = _solve_points_grid(prob, thetas, n_iter,
-                                                    n_f32)
+                                                    n_f32, kernel)
     Vstar, dstar = reduce_deltas(V, conv)
     return V, conv, feas, grad, u0, z, Vstar, dstar
 
 
 def _solve_points_all_deltas_full(prob: DeviceProblem, thetas: jax.Array,
-                                  n_iter: int, n_f32: int = 0):
+                                  n_iter: int, n_f32: int = 0,
+                                  kernel: str = "xla"):
     """Full-output grid solve: _solve_points_all_deltas plus the per-cell
     duals/slacks appended (two-phase phase-1 and the tree-warm-start
     donor rows both need them).  Kept as a SEPARATE program so the
@@ -193,7 +196,8 @@ def _solve_points_all_deltas_full(prob: DeviceProblem, thetas: jax.Array,
     def per_point(theta):
         return jax.vmap(
             lambda d: _solve_one_full(prob, theta, d, n_iter,
-                                      n_f32))(jnp.arange(nd))
+                                      n_f32,
+                                      kernel=kernel))(jnp.arange(nd))
 
     V, conv, feas, grad, u0, z, lam, s, rp, _wok = \
         jax.vmap(per_point)(thetas)
@@ -202,7 +206,7 @@ def _solve_points_all_deltas_full(prob: DeviceProblem, thetas: jax.Array,
 
 
 def _simplex_feas_one(prob: DeviceProblem, bary_M: jax.Array, d: int,
-                      n_iter: int, n_f32: int = 0):
+                      n_iter: int, n_f32: int = 0, kernel: str = "xla"):
     """Joint phase-1 over a simplex: t* = min violation of commutation d's
     constraints over {(z, theta) : theta in R}.
 
@@ -236,7 +240,8 @@ def _simplex_feas_one(prob: DeviceProblem, bary_M: jax.Array, d: int,
     Q = jnp.eye(nz + nt + 1, dtype=dtype) * 1e-9
     Q = Q.at[nz + nt, nz + nt].set(1e-6)
     q = jnp.zeros(nz + nt + 1, dtype=dtype).at[nz + nt].set(1.0)
-    sol = ipm.qp_solve(Q, q, A, b, n_iter=n_iter, n_f32=n_f32)
+    sol = ipm.qp_solve(Q, q, A, b, n_iter=n_iter, n_f32=n_f32,
+                       kernel=kernel)
     # Farkas check on the ORIGINAL system A0 x <= b (t column dropped).
     A0 = A[:, :nz + nt]
     y = sol.lam / jnp.maximum(jnp.sum(sol.lam), 1e-300)
@@ -249,7 +254,7 @@ def _simplex_feas_one(prob: DeviceProblem, bary_M: jax.Array, d: int,
 def _solve_simplex_min_one(prob: DeviceProblem, bary_M: jax.Array,
                            d: int, n_iter: int, n_f32: int = 0,
                            rho_elastic: float = 1e4, warm=None,
-                           full_out: bool = False):
+                           full_out: bool = False, kernel: str = "xla"):
     """Lower bound on min_{theta in R} V_delta(theta): ELASTIC joint QP
     over (z, theta, t).
 
@@ -302,7 +307,7 @@ def _solve_simplex_min_one(prob: DeviceProblem, bary_M: jax.Array,
     # (code-review r3).  rho=1e4 + tol=1e-9 keeps the absolute value
     # error ~1e-5, far below every config's eps.
     sol = ipm.qp_solve(Hj, qj, Gj, bj, n_iter=n_iter, n_f32=n_f32,
-                       tol=1e-9, warm_start=warm)
+                       tol=1e-9, warm_start=warm, kernel=kernel)
     # Clamp: the -t <= 0 row is only honored to the primal tolerance, and
     # a slightly NEGATIVE t would ADD rho*|t| to the reported bound --
     # the unsound direction for a lower bound.  Clamped, any solver error
@@ -342,6 +347,7 @@ class Oracle:
                  phase1_iters_point: int | None = None,
                  phase1_iters_simplex: int | None = None,
                  warm_start: bool = False,
+                 ipm_kernel: str = "auto",
                  obs: "obs_lib.Obs | None" = None):
         """mesh: optional jax.sharding.Mesh with ("batch", "delta") axes;
         when given, solve_vertices shards the (points x commutations) grid
@@ -384,6 +390,17 @@ class Oracle:
         QPs and the joint elastic-simplex programs converge at very
         different rates, so their first-phase lengths can be tuned
         independently; None inherits the shared value / auto split.
+
+        ipm_kernel: IPM dispatch tier (cfg.ipm_kernel): 'auto' probes
+        the backend (TPU -> the fused Pallas micro-kernel of
+        oracle/pallas_ipm.py, CPU -> the XLA reference path), 'pallas'
+        forces the kernel (interpret mode on CPU -- the parity-test
+        configuration), 'xla' forces the reference.  Forced to 'xla'
+        for backend='serial' (its one-QP-at-a-time programs have no
+        tile to fill) and under a mesh (the shard_map grid wire format
+        is XLA-only).  The tier changes per-iteration arithmetic
+        ordering at most (last-ulp): schedules, cohort splits, warm
+        gating, and classification are tier-independent code.
 
         warm_start: accept caller-supplied warm starts on the pair path
         (dispatch_pairs(..., warm=...)) and return final duals/slacks
@@ -585,6 +602,26 @@ class Oracle:
         devs = (jax.local_devices(backend=platform) if platform
                 else jax.local_devices())
         self.device = devs[0]
+        # IPM kernel tier (see __init__ doc).  Resolved ONCE from the
+        # PLACEMENT device's platform -- not the process default
+        # backend: a backend='cpu' oracle (or the device-failure
+        # cpu_twin) on a TPU host executes its programs on CPU, where
+        # 'auto' must stay 'xla' and an explicit 'pallas' must force
+        # interpret mode; keying on jax.default_backend() would lower
+        # Mosaic code for a CPU-placed computation.  self.ipm_kernel
+        # is the public tier (obs gauge / bench / repro-bundle meta);
+        # _ipm_kernel_arg is the qp_solve dispatch string with the
+        # interpret decision baked in (ipm._run_leg parses it).
+        from explicit_hybrid_mpc_tpu.oracle import pallas_ipm
+
+        self.ipm_kernel = pallas_ipm.resolve_kernel_tier(
+            ipm_kernel, platform=self.device.platform)
+        if backend == "serial" or mesh is not None:
+            self.ipm_kernel = "xla"
+        self._ipm_kernel_arg = self.ipm_kernel
+        if (self.ipm_kernel == "pallas"
+                and self.device.platform != "tpu"):
+            self._ipm_kernel_arg = "pallas:interpret"
         self.prob = jax.device_put(to_device(self.can), self.device)
         self._mesh_solver = None
         if mesh is not None and backend == "serial":
@@ -605,20 +642,22 @@ class Oracle:
             self._solve_points = jax.jit(
                 functools.partial(_solve_points_all_deltas_full,
                                   n_iter=grid_p1,
-                                  n_f32=self.point_n_f32))
+                                  n_f32=self.point_n_f32,
+                                  kernel=self._ipm_kernel_arg))
             self._n_grid_out = 11
             # Warm-capable pair phase-1: the frontier's tree-warm-start
             # dispatch and the masked sparse path share this program.
             self._solve_pairs_ws = jax.jit(jax.vmap(
                 lambda th, d, zw, sw, lw, hw: _solve_one_full(
                     self.prob, th, d, grid_p1, self.point_n_f32,
-                    warm=(zw, sw, lw, hw)),
+                    warm=(zw, sw, lw, hw), kernel=self._ipm_kernel_arg),
                 in_axes=(0, 0, 0, 0, 0, 0)))
         else:
             self._solve_points = jax.jit(
                 functools.partial(_solve_points_all_deltas,
                                   n_iter=self.point_n_iter,
-                                  n_f32=self.point_n_f32),
+                                  n_f32=self.point_n_f32,
+                                  kernel=self._ipm_kernel_arg),
                 static_argnames=())
             self._n_grid_out = 8
         if self._point_cohort:
@@ -628,29 +667,34 @@ class Oracle:
             self._solve_pairs_p2 = jax.jit(jax.vmap(
                 lambda th, d, zw, sw, lw: _solve_one_full(
                     self.prob, th, d, self.point_p2, 0,
-                    warm=(zw, sw, lw, True)),
+                    warm=(zw, sw, lw, True), kernel=self._ipm_kernel_arg),
                 in_axes=(0, 0, 0, 0, 0)))
         self._solve_one_point = jax.jit(
             lambda prob, theta: _solve_points_all_deltas(
-                prob, theta[None], self.point_n_iter, self.point_n_f32))
+                prob, theta[None], self.point_n_iter, self.point_n_f32,
+                kernel=self._ipm_kernel_arg))
         if self._simplex_cohort:
             self._simplex_min = jax.jit(
                 jax.vmap(lambda M, d: _solve_simplex_min_one(
                     self.prob, M, d, self.simplex_p1, self.n_f32,
-                    full_out=True), in_axes=(0, 0)))
+                    full_out=True, kernel=self._ipm_kernel_arg),
+                    in_axes=(0, 0)))
             self._simplex_min_p2 = jax.jit(
                 jax.vmap(lambda M, d, zw, sw, lw: _solve_simplex_min_one(
                     self.prob, M, d, self.simplex_p2, 0,
-                    warm=(zw, sw, lw, True), full_out=True),
+                    warm=(zw, sw, lw, True), full_out=True,
+                    kernel=self._ipm_kernel_arg),
                     in_axes=(0, 0, 0, 0, 0)))
         else:
             self._simplex_min = jax.jit(
                 jax.vmap(lambda M, d: _solve_simplex_min_one(
-                    self.prob, M, d, self.n_iter, self.n_f32),
+                    self.prob, M, d, self.n_iter, self.n_f32,
+                    kernel=self._ipm_kernel_arg),
                     in_axes=(0, 0)))
         self._simplex_feas = jax.jit(
             jax.vmap(lambda M, d: _simplex_feas_one(
-                self.prob, M, d, self.n_iter, self.n_f32), in_axes=(0, 0)))
+                self.prob, M, d, self.n_iter, self.n_f32,
+                kernel=self._ipm_kernel_arg), in_axes=(0, 0)))
         # Phase-1 keeps the FULL schedule even under an aggressive
         # point_schedule: it returns a violation scalar with no
         # convergence flag, so a schedule miss has no rescue signal and
@@ -659,24 +703,29 @@ class Oracle:
             jax.vmap(lambda th, d: ipm.phase1(
                 self.prob.G[d],
                 self.prob.w[d] + self.prob.S[d] @ th,
-                n_iter=self.n_iter, n_f32=self.n_f32), in_axes=(0, 0)))
+                n_iter=self.n_iter, n_f32=self.n_f32,
+                kernel=self._ipm_kernel_arg), in_axes=(0, 0)))
         self._solve_fixed = jax.jit(
             jax.vmap(lambda th, d: _solve_one(
-                self.prob, th, d, self.point_n_iter, self.point_n_f32),
+                self.prob, th, d, self.point_n_iter, self.point_n_f32,
+                kernel=self._ipm_kernel_arg),
                 in_axes=(0, 0)))
         # One (point, delta) pair at a time -- the serial-baseline path of
         # solve_pairs (one QP per program, matching the 'serial' contract).
         self._solve_pair_one = jax.jit(
             lambda th, d: _solve_one(self.prob, th, d, self.point_n_iter,
-                                     self.point_n_f32))
+                                     self.point_n_f32,
+                                     kernel=self._ipm_kernel_arg))
         if self.rescue_iter > 0:
             self._solve_rescue = jax.jit(
                 jax.vmap(lambda th, d: _solve_one(
-                    self.prob, th, d, self.rescue_iter, 0),
+                    self.prob, th, d, self.rescue_iter, 0,
+                    kernel=self._ipm_kernel_arg),
                     in_axes=(0, 0)))
             self._rescue_one = jax.jit(
                 lambda th, d: _solve_one(self.prob, th, d,
-                                         self.rescue_iter, 0))
+                                         self.rescue_iter, 0,
+                                         kernel=self._ipm_kernel_arg))
 
     def cpu_twin(self, problem) -> "Oracle":
         """CPU re-instantiation with identical solver semantics -- the
@@ -702,7 +751,11 @@ class Oracle:
             phase1_iters=self.phase1_iters,
             phase1_iters_point=self.phase1_iters_point,
             phase1_iters_simplex=self.phase1_iters_simplex,
-            warm_start=self.warm_start)
+            warm_start=self.warm_start,
+            # The RESOLVED tier, not the request: the twin re-solves
+            # failed batches and must run the same dispatch path the
+            # main oracle would have (on CPU 'pallas' runs interpret).
+            ipm_kernel=self.ipm_kernel)
 
     # -- iteration ledger + metrics --------------------------------------
 
@@ -771,7 +824,9 @@ class Oracle:
         self.compiled_shapes.add((family, int(rows)))
 
     def _obs_batch(self, cls: str, n: int, wall: float,
-                   iters_total: int, iters_f64: int | None = None) -> None:
+                   iters_total: int, iters_f64: int | None = None,
+                   tiles: int | None = None,
+                   kernel_f32: int = 0) -> None:
         """Fold one batched device query into the metrics registry:
         per-QP blocking-wait latency (observed with weight n so the
         `oracle.<cls>_solve_s` histogram's quantiles stay per-solve
@@ -801,6 +856,34 @@ class Oracle:
         # off" from "rate 0 over thousands of rejected donors".
         m.gauge("oracle.warm_attempts").set(self.n_warm_attempts)
         m.gauge("oracle.compiled_shapes").set(len(self.compiled_shapes))
+        # Kernel-tier observables (oracle/pallas_ipm.py): which IPM
+        # dispatch tier this oracle runs (0 = xla reference, 1 = fused
+        # pallas kernel) plus, under the pallas tier, blocking-wait
+        # wall per kernel-launch tile.  `tiles` is the caller's launch
+        # count -- the (points x deltas) grid passes points *
+        # tile_count(nd) since the inner deltas axis is the tile and
+        # the points axis becomes a grid dimension; single-vmap pair/
+        # simplex batches default to tile_count(n).  An ESTIMATE
+        # (chunking rounds up per chunk, cohort phase-2 launches fold
+        # into the same wall), not a device profile.
+        m.gauge("oracle.ipm_kernel").set(
+            1.0 if self.ipm_kernel == "pallas" else 0.0)
+        if self.ipm_kernel != "pallas":
+            return
+        # Pure-f64 programs on a REAL TPU lowering never reach the
+        # kernel (Mosaic has no f64: ipm._run_leg routes them to the
+        # XLA body), so their wall must not pollute the per-tile
+        # figure bench_gate gates -- the rescue pass is the main such
+        # program (kernel_f32 = the batch's f32-leg length).  Under
+        # interpret mode every leg runs the kernel.
+        if self._ipm_kernel_arg == "pallas" and kernel_f32 <= 0:
+            return
+        from explicit_hybrid_mpc_tpu.oracle import pallas_ipm
+
+        tiles = max(1, tiles if tiles is not None
+                    else pallas_ipm.tile_count(n))
+        m.histogram("oracle.ipm_kernel_tile_s").observe(
+            wall / tiles, n=tiles)
 
     # -- flight-recorder capture (obs/recorder.py) -------------------------
 
@@ -995,6 +1078,13 @@ class Oracle:
         n = thetas.shape[0] * self.can.n_delta
         self.n_solves += n
         self.n_point_solves += n
+        # Grid-program launch accounting for the kernel-tile histogram:
+        # custom_vmap tiles the INNER deltas axis and the points axis
+        # rides as a pallas grid dimension, so launches are
+        # points * tile_count(nd), not tile_count(points * nd).
+        from explicit_hybrid_mpc_tpu.oracle import pallas_ipm
+        grid_tiles = (thetas.shape[0]
+                      * pallas_ipm.tile_count(self.can.n_delta))
         if self._point_full_out and kind == "chunks":
             p1 = (self.point_p1 if self._point_cohort
                   else self.point_n_iter)
@@ -1004,14 +1094,17 @@ class Oracle:
                 self.n_tp_survivors += surv
             self._iters(n * self.point_n_f32, f64, n * self.point_n_iter)
             self._obs_batch("point", n, time.perf_counter() - t0,
-                            n * self.point_n_f32 + f64, f64)
+                            n * self.point_n_f32 + f64, f64,
+                            tiles=grid_tiles,
+                            kernel_f32=self.point_n_f32)
         else:
             f64 = n * self.point_n_iter
             self._iters(n * self.point_n_f32, f64, f64)
             self._obs_batch("point", n, time.perf_counter() - t0,
                             n * ipm.schedule_iters(self.point_n_f32,
                                                    self.point_n_iter),
-                            f64)
+                            f64, tiles=grid_tiles,
+                            kernel_f32=self.point_n_f32)
         sol = VertexSolution(*self._finalize(parts), lam=lam, s=s)
         if self.recorder is not None:
             # Grid cells replay bit-for-bit through the pair path: the
@@ -1089,6 +1182,8 @@ class Oracle:
         f64 = K * self.rescue_iter
         self._iters(0, f64, f64)
         self._obs_batch("rescue", K, time.perf_counter() - t0, f64, f64)
+        # (kernel_f32 left 0: the rescue program is pure f64 -- on a
+        # real TPU lowering it never launches the kernel.)
         return parts
 
     def _pad_pairs(self, thetas: np.ndarray, ds: np.ndarray,
@@ -1326,7 +1421,8 @@ class Oracle:
         self._obs_batch("simplex", self.n_solves - n_before,
                         time.perf_counter() - t0,
                         self.n_iters_f32 + self.n_iters_f64 - it0,
-                        self.n_iters_f64 - f64_0)
+                        self.n_iters_f64 - f64_0,
+                        kernel_f32=self.n_f32)
         out_all = np.concatenate(outs)
         feas_all = np.concatenate(feas_sw)
         if self.recorder is not None:
@@ -1454,7 +1550,7 @@ class Oracle:
         t, conv, farkas = self._run_simplex_feas(bary_Ms, delta_idx)
         it = K * ipm.schedule_iters(self.n_f32, self.n_iter)
         self._obs_batch("simplex", K, time.perf_counter() - t0,
-                        it, K * self.n_iter)
+                        it, K * self.n_iter, kernel_f32=self.n_f32)
         return t, conv & (t <= 1e-6), conv & (t > 1e-6) & farkas
 
     # -- fixed-commutation (point, delta) pair solves ----------------------
@@ -1609,7 +1705,8 @@ class Oracle:
                 self.n_tp_survivors += surv
             self._iters(K * self.point_n_f32, f64, K * self.point_n_iter)
             self._obs_batch("point", K, time.perf_counter() - t0,
-                            K * self.point_n_f32 + f64, f64)
+                            K * self.point_n_f32 + f64, f64,
+                            kernel_f32=self.point_n_f32)
             Vout = np.where(conv, V, _INF)
             if self.recorder is not None:
                 self._capture_pairs(thetas, delta_idx, conv, feas, Vout,
@@ -1638,7 +1735,8 @@ class Oracle:
         self._iters(K * self.point_n_f32, f64, f64)
         self._obs_batch("point", K, time.perf_counter() - t0,
                         K * ipm.schedule_iters(self.point_n_f32,
-                                               self.point_n_iter), f64)
+                                               self.point_n_iter), f64,
+                        kernel_f32=self.point_n_f32)
         Vout = np.where(conv, V, _INF)
         if self.recorder is not None:
             self._capture_pairs(thetas, delta_idx, conv, feas, Vout)

@@ -1,0 +1,358 @@
+"""Fused Pallas TPU micro-kernel for the batched IPM hot loop.
+
+The offline partition build is dominated by per-vertex QP oracle calls
+(SURVEY.md section 4.1): after PR 6 removed duplicate solves, the
+batched Mehrotra kernel (oracle/ipm.py) IS the build wall time.  The
+XLA lowering of that kernel runs each predictor-corrector iteration as
+a chain of generic batched ops -- a `jnp.linalg.cholesky`, two
+`cho_solve`s, and a dozen elementwise passes over tiny (nz x nz)
+matrices -- and every intermediate bounces through HBM between ops.
+
+This module fuses the ENTIRE fixed-iteration schedule of one precision
+leg into a single kernel launch per (schedule leg x batch tile): KKT
+assembly (M = Q + A'(Lam/S)A), an in-register blocked Cholesky
+(rank-1-downdate form: nz static steps of fully-vectorized tile-wide
+updates), forward/backward substitution, the fraction-to-boundary line
+search, and the Mehrotra centering bookkeeping all run out of VMEM.
+HBM traffic is one read of (Q, q, A, b, warm state) and one write of
+(z, s, lam) per leg instead of per iteration.
+
+Integration contract (the reason callers never change):
+
+- `mehrotra_leg(n_iter)` is a `jax.custom_batching.custom_vmap`
+  function with the same signature as one XLA leg.  `ipm.qp_solve`
+  calls it INSIDE its existing per-QP code under `kernel='pallas'`;
+  jax's vmap then routes batched callers (the oracle's vmapped
+  programs, including the nested (points x deltas) grid) into the
+  tiled pallas_call, while unbatched callers (the serial baseline's
+  one-QP-at-a-time programs) fall through to the reference XLA body.
+  Equilibration, warm-start merit gating, the two-phase cohort split,
+  and the final residual classification all stay in `ipm.qp_solve` --
+  shared, once -- so `Oracle`, the pipeline, and replay bundles are
+  untouched callers and `schedule_iters` accounting is exact by
+  construction (the kernel runs exactly `n_iter` iterations).
+- The XLA path remains the semantic reference: interpret-mode parity
+  tests (tests/test_pallas_ipm.py) assert the kernel reproduces the
+  XLA path's converged masks exactly and its iterates to tight
+  tolerance on the point, elastic-simplex, and Farkas program
+  families.
+
+Precision/lowering notes: point location's Pallas kernel
+(online/pallas_eval.py) is pure f32; this kernel is dtype-generic
+because the schedule has BOTH an f32 leg and an f64 polish leg.
+Mosaic has no f64, so on a real TPU backend only the f32 leg lowers
+through the kernel and the f64 polish leg falls back to the XLA path
+(which XLA emulates, as before) -- `ipm._run_leg` holds that guard.
+On CPU hosts the kernel executes in interpret mode (pallas evaluates
+the kernel as jax ops), where the f64 leg works too; that is the CI
+parity surface.  All in-kernel matvecs/outer products are
+broadcast-multiply-reduce VPU ops (no MXU dots), so the f32 leg does
+not need a matmul-precision override to avoid bf16 passes.
+"""
+# tpulint: x32-module
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.custom_batching import custom_vmap
+from jax.experimental import pallas as pl
+
+# Cycle-free: ipm defers ITS pallas_ipm import to inside _run_leg.
+# Sharing _make_body (the unbatched fallback) and
+# _fraction_to_boundary (already tile-batched: reductions are axis=-1)
+# is the parity contract in code form -- a tweak to the reference
+# algebra flows into the kernel instead of silently diverging.
+from explicit_hybrid_mpc_tpu.oracle import ipm as _ipm
+
+#: Kernel dispatch tiers (cfg.ipm_kernel / Oracle(ipm_kernel=...)).
+KERNEL_TIERS = ("auto", "pallas", "xla")
+
+#: QPs per kernel instance.  8 keeps the tile-wide (TILE, nz) row
+#: operations on full VPU sublanes while bounding VMEM (see
+#: tile_vmem_bytes); small batches shrink the tile instead of padding
+#: 4x (e.g. the nd=2 inner grid axis runs a 2-wide tile).
+TILE = 8
+
+#: Per-tile VMEM budget in bytes.  ~16 MB/core total; half is left for
+#: pipelining the next tile's operand DMA.  Shapes whose working set
+#: exceeds this shrink the tile (worst case 1 QP per instance).
+VMEM_BUDGET = 8 * 2 ** 20
+
+_TINY = 1e-12
+
+
+def resolve_kernel_tier(requested: str, platform: str | None = None) -> str:
+    """'auto'|'pallas'|'xla' -> the effective tier.
+
+    `platform` is the PLACEMENT platform of the programs that will run
+    the kernel (Oracle passes its device's platform; None = the
+    process default backend).  'auto' selects 'pallas' only for a TPU
+    placement: the fused kernel targets real accelerators, and a
+    CPU-placed oracle on a TPU host (backend='cpu', or the
+    device-failure cpu_twin) must NOT inherit the host's default
+    backend -- its programs execute on CPU, where only interpret mode
+    is valid.  Explicit 'pallas' is honored anywhere (interpret mode
+    off-TPU -- the parity-test configuration)."""
+    if requested not in KERNEL_TIERS:
+        raise ValueError(f"unknown ipm_kernel {requested!r} "
+                         f"(expected one of {KERNEL_TIERS})")
+    if platform is None:
+        platform = jax.default_backend()
+    if requested == "auto":
+        return "pallas" if platform == "tpu" else "xla"
+    return requested
+
+
+def interpret_mode() -> bool:
+    """Pallas interpret mode: everywhere except a real TPU backend.
+    Process-level default only -- callers whose programs are placed on
+    a non-default device must force interpret explicitly (the
+    'pallas:interpret' kernel arg ipm._run_leg parses)."""
+    return jax.default_backend() != "tpu"
+
+
+def tile_vmem_bytes(tile: int, nz: int, nc: int, itemsize: int) -> int:
+    """Working-set estimate for one kernel instance: operands
+    (Q, A, q, b), the iterate carry, the KKT matrix + its Cholesky
+    factor + the rank-1 downdate accumulator, and the (tile, nc, nz, nz)
+    outer-product intermediate of the KKT assembly (the peak term)."""
+    mats = 3 * nz * nz + nc * nz          # Q, M, L/C + A
+    vecs = 2 * nz + 8 * nc                # q, z + b, s, lam, residuals
+    outer = nc * nz * nz                  # KKT-assembly intermediate
+    return tile * (mats + vecs + outer) * itemsize
+
+
+def _batch_tile(K: int) -> int:
+    """Batch-shrink rule: the widest tile <= TILE that does not pad a
+    K-row batch past its pow-2 bucket -- the ONE formula behind both
+    _pick_tile (the lowering) and tile_count (the obs estimate)."""
+    return min(TILE, 1 << max(0, (K - 1).bit_length()))
+
+
+def tile_count(K: int) -> int:
+    """Kernel launch instances for a single-vmap batch of K QPs --
+    the obs-accounting estimate behind oracle.ipm_kernel_tile_s
+    (VMEM-cap shrinkage is ignored, and an outer vmap level
+    multiplies launches by ITS axis size -- Oracle.wait_vertices
+    accounts the (points x deltas) grid as points * tile_count(nd))."""
+    if K <= 0:
+        return 0
+    return -(-K // _batch_tile(K))
+
+
+def _pick_tile(K: int, nz: int, nc: int, itemsize: int) -> int:
+    """Largest tile <= TILE that fits the VMEM budget and does not
+    pad a small batch to 4x its size."""
+    tile = _batch_tile(K)
+    while tile > 1 and tile_vmem_bytes(tile, nz, nc,
+                                       itemsize) > VMEM_BUDGET:
+        tile //= 2
+    return tile
+
+
+# -- in-kernel linear algebra (tile-batched, static shapes) ---------------
+
+def _mv(M, v):
+    """Batched matvec (T, m, n) @ (T, n) -> (T, m) as a VPU
+    broadcast-multiply-reduce (no MXU dot: per-QP operands are far
+    below the 128x128 systolic tile, and the reduce keeps the f32 leg
+    exact without a matmul-precision override)."""
+    return jnp.sum(M * v[:, None, :], axis=-1)
+
+
+def _mtv(M, v):
+    """Batched M'v: (T, m, n), (T, m) -> (T, n)."""
+    return jnp.sum(M * v[:, :, None], axis=1)
+
+
+def _chol_factor(M, reg, nz, dtype):
+    """Tile-batched Cholesky of M + reg*I in rank-1-downdate form:
+    nz static steps, each a fully-vectorized (T, nz) column extraction
+    plus a (T, nz, nz) outer-product downdate -- no dynamic indexing,
+    no per-QP serialization, and the whole factor stays in VMEM."""
+    C = M + reg * jnp.eye(nz, dtype=dtype)
+    rows = jax.lax.broadcasted_iota(jnp.int32, (1, nz), 1)
+    floor = jnp.asarray(1e-300 if dtype != jnp.float32 else 1e-30, dtype)
+    cols = []
+    for j in range(nz):
+        d = jnp.sqrt(jnp.maximum(C[:, j, j], jnp.asarray(0.0, dtype)))
+        col = C[:, :, j] / jnp.maximum(d, floor)[:, None]
+        col = jnp.where(rows >= j, col, jnp.asarray(0.0, dtype))
+        cols.append(col)
+        C = C - col[:, :, None] * col[:, None, :]
+    return jnp.stack(cols, axis=-1)
+
+
+def _fwd_sub(L, r, nz):
+    """Solve L y = r (tile-batched, column-oriented, unrolled)."""
+    rows = jax.lax.broadcasted_iota(jnp.int32, (1, nz), 1)
+    y = r
+    for j in range(nz):
+        d = y[:, j] / L[:, j, j]
+        y = y - d[:, None] * jnp.where(rows > j, L[:, :, j], 0.0)
+        y = jnp.where(rows == j, d[:, None], y)
+    return y
+
+
+def _bwd_sub(L, r, nz):
+    """Solve L' x = r (tile-batched, column-oriented, unrolled)."""
+    rows = jax.lax.broadcasted_iota(jnp.int32, (1, nz), 1)
+    x = r
+    for j in reversed(range(nz)):
+        d = x[:, j] / L[:, j, j]
+        x = x - d[:, None] * jnp.where(rows < j, L[:, j, :], 0.0)
+        x = jnp.where(rows == j, d[:, None], x)
+    return x
+
+
+# Fraction-to-boundary: ipm's implementation is already tile-batched
+# (its reductions are axis=-1), so the kernel shares it verbatim --
+# one definition, bitwise parity by construction.
+_ftb = _ipm._fraction_to_boundary
+
+
+def _make_leg_kernel(n_iter: int, nz: int, nc: int, dtype):
+    """The fused kernel body: `n_iter` Mehrotra predictor-corrector
+    steps for one (tile, nz, nc) block, algebra identical to
+    `ipm._make_body` (regularization and thresholds come from the
+    SHARED ipm.leg_constants; the centering exponent and step rules
+    are pinned by the parity tests)."""
+    reg, tiny = _ipm.leg_constants(dtype)
+
+    def kernel(Q_ref, q_ref, A_ref, b_ref, z_ref, s_ref, l_ref,
+               zo_ref, so_ref, lo_ref):
+        Q = Q_ref[:]
+        q = q_ref[:]
+        A = A_ref[:]
+        b = b_ref[:]
+
+        def body(_, carry):
+            z, s, lam = carry
+            s = jnp.maximum(s, tiny)
+            lam = jnp.maximum(lam, tiny)
+            r_d = _mv(Q, z) + q + _mtv(A, lam)
+            r_p = _mv(A, z) + s - b
+            mu = jnp.sum(s * lam, axis=-1) / nc
+
+            D = lam / s
+            # KKT assembly: M = Q + A' diag(D) A as a sum of nc
+            # tile-wide outer products (the dominant VMEM term; see
+            # tile_vmem_bytes).
+            M = Q + jnp.sum(
+                A[:, :, :, None] * (D[:, :, None, None]
+                                    * A[:, :, None, :]), axis=1)
+            L = _chol_factor(M, jnp.asarray(reg, dtype), nz, dtype)
+
+            def kkt_step(r_c):
+                rhs = -r_d - _mtv(A, D * r_p - r_c / s)
+                dz = _bwd_sub(L, _fwd_sub(L, rhs, nz), nz)
+                dlam = D * (_mv(A, dz) + r_p) - r_c / s
+                ds = -(r_c + s * dlam) / lam
+                return dz, ds, dlam
+
+            dz_a, ds_a, dl_a = kkt_step(s * lam)
+            a_p = _ftb(s, ds_a, 1.0)
+            a_d = _ftb(lam, dl_a, 1.0)
+            mu_aff = jnp.sum((s + a_p[:, None] * ds_a)
+                             * (lam + a_d[:, None] * dl_a), axis=-1) / nc
+            sigma = (mu_aff / jnp.maximum(mu, _TINY)) ** 3
+
+            r_c = s * lam + ds_a * dl_a - (sigma * mu)[:, None]
+            dz, ds, dlam = kkt_step(r_c)
+            a_p = _ftb(s, ds, 0.995)[:, None]
+            a_d = _ftb(lam, dlam, 0.995)[:, None]
+            return (z + a_p * dz, s + a_p * ds, lam + a_d * dlam)
+
+        z, s, lam = jax.lax.fori_loop(
+            0, n_iter, body, (z_ref[:], s_ref[:], l_ref[:]))
+        zo_ref[:] = z
+        so_ref[:] = s
+        lo_ref[:] = lam
+
+    return kernel
+
+
+def solve_tiles(Q, q, A, b, z, s, lam, n_iter: int,
+                interpret: bool | None = None):
+    """Run one fused Mehrotra leg over a (K, ...) batch of QPs: pad K
+    to a tile multiple, launch grid=(K/tile,), slice the padding off.
+    Padding rows are benign identity QPs (Q=I, A=0, b=1, unit
+    slacks/duals) so their iterates stay finite.  One launch per call
+    == one launch per schedule leg; per-QP HBM traffic is one operand
+    read + one iterate write."""
+    K, nz = q.shape
+    nc = b.shape[1]
+    dtype = Q.dtype
+    if interpret is None:
+        interpret = interpret_mode()
+    tile = _pick_tile(K, nz, nc, dtype.itemsize)
+    Kpad = tile * (-(-K // tile))
+    pad = Kpad - K
+    if pad:
+        eye = jnp.broadcast_to(jnp.eye(nz, dtype=dtype), (pad, nz, nz))
+        Q = jnp.concatenate([Q, eye])
+        q = jnp.concatenate([q, jnp.zeros((pad, nz), dtype)])
+        A = jnp.concatenate([A, jnp.zeros((pad, nc, nz), dtype)])
+        b = jnp.concatenate([b, jnp.ones((pad, nc), dtype)])
+        z = jnp.concatenate([z, jnp.zeros((pad, nz), dtype)])
+        s = jnp.concatenate([s, jnp.ones((pad, nc), dtype)])
+        lam = jnp.concatenate([lam, jnp.ones((pad, nc), dtype)])
+    out = pl.pallas_call(
+        _make_leg_kernel(n_iter, nz, nc, dtype),
+        grid=(Kpad // tile,),
+        in_specs=[
+            pl.BlockSpec((tile, nz, nz), lambda i: (i, 0, 0)),
+            pl.BlockSpec((tile, nz), lambda i: (i, 0)),
+            pl.BlockSpec((tile, nc, nz), lambda i: (i, 0, 0)),
+            pl.BlockSpec((tile, nc), lambda i: (i, 0)),
+            pl.BlockSpec((tile, nz), lambda i: (i, 0)),
+            pl.BlockSpec((tile, nc), lambda i: (i, 0)),
+            pl.BlockSpec((tile, nc), lambda i: (i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((tile, nz), lambda i: (i, 0)),
+            pl.BlockSpec((tile, nc), lambda i: (i, 0)),
+            pl.BlockSpec((tile, nc), lambda i: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((Kpad, nz), dtype),
+            jax.ShapeDtypeStruct((Kpad, nc), dtype),
+            jax.ShapeDtypeStruct((Kpad, nc), dtype),
+        ],
+        interpret=interpret,
+    )(Q, q, A, b, z, s, lam)
+    return tuple(o[:K] for o in out)
+
+
+@functools.lru_cache(maxsize=64)
+def mehrotra_leg(n_iter: int, interpret: bool | None = None):
+    """One fused Mehrotra leg as a per-QP function, batched via
+    custom_vmap into the tiled kernel.
+
+    Returns f(Q, q, A, b, z, s, lam) -> (z, s, lam) with the exact
+    signature of the XLA leg in ipm.qp_solve.  Under vmap -- every
+    batched oracle program -- the custom rule runs `solve_tiles`;
+    under a SECOND vmap level (the (points x deltas) grid program) the
+    pallas_call's own batching rule prepends a grid axis, so the inner
+    axis stays a real VMEM tile.  Unbatched calls (the serial
+    baseline's one-QP programs) fall through to the reference XLA
+    body: there is no tile to fill, and the serial contract is "the
+    reference semantics, one program per QP"."""
+
+    @custom_vmap
+    def leg(Q, q, A, b, z, s, lam):
+        body = _ipm._make_body(Q, q, A, b)
+        return jax.lax.fori_loop(0, n_iter, body, (z, s, lam))
+
+    @leg.def_vmap
+    def _leg_vmap(axis_size, in_batched, *args):
+        args = [a if batched
+                else jnp.broadcast_to(a, (axis_size,) + a.shape)
+                for a, batched in zip(args, in_batched)]
+        out = solve_tiles(*args, n_iter=n_iter, interpret=interpret)
+        return out, (True, True, True)
+
+    return leg

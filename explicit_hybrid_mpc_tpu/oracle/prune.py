@@ -205,10 +205,16 @@ class PrunedOracle(Oracle):
         self._var_idx = var_idx
         self._Gd, self._wd, self._Sd = Gd, wd, Sd
         red_dev = self._red_dev
+        # Reduced programs run the SAME resolved kernel tier as the
+        # base oracle's (super().__init__ set _ipm_kernel_arg): the
+        # pruned point/simplex paths ARE the hot path pruning targets,
+        # and the oracle's ipm_kernel gauge / bench row must describe
+        # what they actually dispatch.
         self._solve_pairs_red = jax.jit(jax.vmap(
             lambda th, d: omod._solve_one(red_dev, th, d,
                                           self.point_n_iter,
-                                          self.point_n_f32),
+                                          self.point_n_f32,
+                                          kernel=self._ipm_kernel_arg),
             in_axes=(0, 0)))
         # Pruned elastic simplex-min: same joint program on the reduced
         # rows/vars.  Its bound is sound UNCONDITIONALLY (dropping rows
@@ -216,7 +222,8 @@ class PrunedOracle(Oracle):
         # dropped rows (the verified case); violators re-solve full.
         self._simplex_min_red = jax.jit(jax.vmap(
             lambda M, d: omod._solve_simplex_min_one(
-                red_dev, M, d, self.n_iter, self.n_f32),
+                red_dev, M, d, self.n_iter, self.n_f32,
+                kernel=self._ipm_kernel_arg),
             in_axes=(0, 0)))
         # Reduced phase-1, the gate behind _stalled_need_resolve: full
         # schedule for the same reason as the base _point_feas (phase-1
@@ -225,7 +232,8 @@ class PrunedOracle(Oracle):
         self._point_feas_red = jax.jit(
             jax.vmap(lambda th, d: ipm.phase1(
                 red_dev.G[d], red_dev.w[d] + red_dev.S[d] @ th,
-                n_iter=self.n_iter, n_f32=self.n_f32), in_axes=(0, 0)))
+                n_iter=self.n_iter, n_f32=self.n_f32,
+                kernel=self._ipm_kernel_arg), in_axes=(0, 0)))
 
     # -- helpers -----------------------------------------------------------
 

@@ -1468,7 +1468,8 @@ class FrontierEngine:
             # parity; main.py applies the same back-fill on its path).
             for fld, legacy in (("ipm_two_phase", False),
                                 ("ipm_phase1_iters", None),
-                                ("warm_start_tree", False)):
+                                ("warm_start_tree", False),
+                                ("ipm_kernel", "xla")):
                 if fld not in cfg_snap.__dict__:
                     object.__setattr__(cfg_snap, fld, legacy)
             cfg = cfg_snap
@@ -1563,7 +1564,11 @@ def make_oracle(problem, cfg: PartitionConfig, mesh=None,
                                          None),
               phase1_iters_simplex=getattr(cfg, "ipm_phase1_iters_simplex",
                                            None),
-              warm_start=getattr(cfg, "warm_start_tree", False))
+              warm_start=getattr(cfg, "warm_start_tree", False),
+              # Pre-tier pickled cfgs (no ipm_kernel field) keep the
+              # XLA reference path, like the other conservative
+              # fallbacks above.
+              ipm_kernel=getattr(cfg, "ipm_kernel", "xla"))
     if getattr(cfg, "prune_rows", False):
         if cfg.backend == "serial" or mesh is not None:
             if strict:

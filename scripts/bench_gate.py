@@ -81,12 +81,19 @@ GATED_METRICS: dict[str, tuple] = {
     # regions/s with QPS semantics.
     "serve_p99_us": ("lower", 0.25, 1000.0),
     "fallback_frac": ("lower", 0.15, 0.02),
+    # Fused Pallas IPM micro-kernel (oracle/pallas_ipm.py): p50
+    # blocking-wait wall per kernel-launch tile.  Only captures that
+    # actually ran the pallas tier carry the field (CPU 'auto' runs
+    # the XLA reference and records None -- such rows gate nothing, so
+    # the trailing window never mixes tiers); the absolute slack
+    # absorbs host-timing jitter on near-idle tiles.
+    "ipm_kernel_tile_us": ("lower", 0.25, 50.0),
 }
 
 _ROW_EXTRAS = ("regions", "unit", "precision", "truncated",
                "device_failures", "uncertified",
                "serve_qps", "serve_batch_fill", "swap_dropped",
-               "swap_torn")
+               "swap_torn", "ipm_kernel")
 
 
 def summarize(bench: dict, source: str, mtime: float | None = None) -> dict:

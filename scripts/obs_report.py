@@ -188,6 +188,12 @@ def bench_warnings(bench: dict) -> list[str]:
     if bench.get("backend_probe_failed"):
         warns.append("bench ran on the honest-CPU fallback "
                      "(device backend unreachable)")
+    # backend_probe_skipped (CPU-only host: the accelerator probe came
+    # back negative but the CPU-pinned probe was clean) is NOT a
+    # warning: a CPU-only capture is the expected configuration there,
+    # and rendering it as an error made every clean CPU run look
+    # degraded (BENCH_r05).  The probe detail rides in
+    # backend_probe_detail for triage.
     if bench.get("backend_init_failed"):
         warns.append("bench backend init failed after an OK probe; "
                      "fell back to CPU")

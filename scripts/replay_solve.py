@@ -72,6 +72,8 @@ def _build_oracle(meta: dict, backend: str | None):
         two_phase=bool(okw["two_phase"]),
         phase1_iters=okw["phase1_iters"],
         warm_start=bool(okw["warm_start"]),
+        # Pre-tier bundles replay on the XLA reference path.
+        ipm_kernel=okw.get("ipm_kernel", "xla"),
         stage2_order=("phase1_first" if okw["stage2_phase1_first"]
                       else "min_first")), backend, cap_backend
 
@@ -91,7 +93,8 @@ def _vmin_class(v: np.ndarray) -> np.ndarray:
 
 
 def replay_bundle(path: str, backend: str | None = None,
-                  kernel_only: bool = False) -> dict:
+                  kernel_only: bool = False,
+                  kernel_tier: str | None = None) -> dict:
     """Replay one bundle; returns the structured report dict (see
     module docstring for the per-kind contract).  report["ok"] is the
     exit-status verdict."""
@@ -111,7 +114,12 @@ def replay_bundle(path: str, backend: str | None = None,
         if kind not in ("pairs", "vertices"):
             raise SystemExit(f"--kernel-only needs a pairs/vertices "
                              f"bundle, got kind={kind!r}")
-        return _replay_kernel_only(rep, meta, arrays, can)
+        return _replay_kernel_only(rep, meta, arrays, can,
+                                   kernel_tier=kernel_tier)
+    if kernel_tier is not None:
+        raise SystemExit("--kernel-tier only applies to --kernel-only "
+                         "(pipeline replays run the bundle's recorded "
+                         "tier)")
 
     oracle, used_backend, cap_backend = _build_oracle(meta, backend)
     rep["replay_backend"] = used_backend
@@ -219,11 +227,18 @@ def replay_bundle(path: str, backend: str | None = None,
     return rep
 
 
-def _replay_kernel_only(rep: dict, meta: dict, arrays: dict, can) -> dict:
-    """Bare-kernel probe on the realized per-cell QP matrices."""
+def _replay_kernel_only(rep: dict, meta: dict, arrays: dict, can,
+                        kernel_tier: str | None = None) -> dict:
+    """Bare-kernel probe on the realized per-cell QP matrices.
+
+    kernel_tier: 'pallas'|'xla' override of the bundle's recorded
+    tier -- replaying the same bundle through BOTH tiers is the
+    bisection step for attributing a mismatch to the fused kernel vs
+    the XLA reference."""
     from explicit_hybrid_mpc_tpu.oracle import ipm
 
     okw = meta["oracle"]
+    tier = kernel_tier or okw.get("ipm_kernel", "xla")
     if rep["kind"] == "pairs":
         thetas, ds = arrays["thetas"], arrays["delta_idx"]
     else:  # vertices: flatten the anomalous grid to pairs
@@ -239,8 +254,9 @@ def _replay_kernel_only(rep: dict, meta: dict, arrays: dict, can) -> dict:
     conv, feas, rp = ipm.solve_mask(
         Q, q, A, b,
         n_iter=int(okw["point_n_iter"]),
-        n_f32=int(okw["point_n_f32"]))
-    rep.update(kernel_only=True, n_cells=K,
+        n_f32=int(okw["point_n_f32"]),
+        kernel=tier)
+    rep.update(kernel_only=True, n_cells=K, kernel_tier=tier,
                kernel_converged=int(conv.sum()),
                kernel_feasible=int(feas.sum()),
                kernel_rp_max=float(np.max(rp)) if K else 0.0,
@@ -262,6 +278,13 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--kernel-only", action="store_true",
                     help="bypass the Oracle pipeline; probe the bare "
                          "fixed-iteration kernel on the realized QPs")
+    ap.add_argument("--kernel-tier", default=None,
+                    choices=("pallas", "xla"),
+                    help="with --kernel-only: force the IPM dispatch "
+                         "tier (default: the bundle's recorded tier) "
+                         "-- replay through both tiers to attribute a "
+                         "mismatch to the fused Pallas kernel vs the "
+                         "XLA reference")
     ap.add_argument("--strict-cell", action="store_true",
                     help="gate the exit status on cell-bundle vertex "
                          "conv reproduction too (cold replay may flip "
@@ -271,7 +294,8 @@ def main(argv: list[str] | None = None) -> int:
     args = ap.parse_args(argv)
 
     rep = replay_bundle(args.bundle, backend=args.backend,
-                        kernel_only=args.kernel_only)
+                        kernel_only=args.kernel_only,
+                        kernel_tier=args.kernel_tier)
     if args.strict_cell and rep.get("kind") == "cell":
         rep["ok"] = bool(rep["ok"] and rep.get("cell_conv_reproduced"))
     for k in sorted(rep):

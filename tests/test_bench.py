@@ -70,6 +70,58 @@ def test_bench_probe_failure_is_not_fatal():
         sys.path.remove(REPO)
 
 
+def test_probe_skip_vs_failure_classification(monkeypatch):
+    """ISSUE 9 satellite: a clean CPU-only host (accelerator probe
+    negative, CPU-pinned probe fine -- the shape of every committed
+    BENCH_rNN capture on this container) must record
+    backend_probe_skipped, NOT backend_probe_failed; the probe detail
+    moves to backend_probe_detail so obs_report stops rendering the
+    expected configuration as a degraded capture.  A host where even
+    the CPU probe dies keeps the genuine-failure fields."""
+    sys.path.insert(0, REPO)
+    try:
+        import bench
+
+        monkeypatch.delenv("BENCH_PLATFORM", raising=False)
+
+        def fail_probe(timeout_s, result=None):
+            if result is not None:
+                result["backend_probe_error"] = "probe timed out"
+            return None
+
+        monkeypatch.setattr(bench, "probe_backend", fail_probe)
+        monkeypatch.setattr(bench, "probe_cpu_only", lambda t: True)
+        res = {}
+        assert bench.choose_backend(
+            res, hold_capture_sentinel=False) == "cpu"
+        assert res.get("backend_probe_skipped") is True
+        assert "backend_probe_failed" not in res
+        assert "backend_probe_error" not in res
+        assert res.get("backend_probe_detail") == "probe timed out"
+
+        monkeypatch.setattr(bench, "probe_cpu_only", lambda t: False)
+        res2 = {}
+        assert bench.choose_backend(
+            res2, hold_capture_sentinel=False) == "cpu"
+        assert res2.get("backend_probe_failed") is True
+        assert res2.get("backend_probe_error") == "probe timed out"
+        assert "backend_probe_skipped" not in res2
+
+        # obs_report classification: skipped is NOT a warning (the
+        # whole point); genuine failures still warn.
+        sys.path.insert(0, os.path.join(REPO, "scripts"))
+        try:
+            import obs_report
+        finally:
+            sys.path.remove(os.path.join(REPO, "scripts"))
+        assert obs_report.bench_warnings(
+            {"backend_probe_skipped": True,
+             "backend_probe_detail": "probe timed out"}) == []
+        assert obs_report.bench_warnings(res2)
+    finally:
+        sys.path.remove(REPO)
+
+
 def test_bench_smoke_carries_host_fields():
     """r4 weak #1: the driver capture silently reported half the real
     throughput while a background campaign ran.  The JSON must carry the

@@ -109,6 +109,22 @@ def test_mixed_precision_infeasible_still_detected():
     assert not bool(sol.feasible) and not bool(sol.converged)
 
 
+def test_mask_solver_cache_keying():
+    """Regression guard for the PR 5 fix: _mask_solver is lru_cached
+    on the FULL schedule key.  Identical (n_iter, n_f32, tol, kernel)
+    tuples must hit the cache (same callable object -- rebuilding a
+    jax.jit wrapper per call is the recompile hazard tpulint caught);
+    nearby-but-distinct float tolerances, and distinct kernel tiers,
+    must mint DISTINCT solvers (a shared one would silently solve at
+    the wrong tolerance / through the wrong tier)."""
+    a = ipm._mask_solver(12, 0, 1e-8, "xla")
+    assert ipm._mask_solver(12, 0, 1e-8, "xla") is a
+    assert ipm._mask_solver(12, 0, 1e-8 * (1 + 1e-12), "xla") is not a
+    assert ipm._mask_solver(12, 0, 2e-8, "xla") is not a
+    assert ipm._mask_solver(12, 0, 1e-8, "pallas") is not a
+    assert ipm._mask_solver(13, 0, 1e-8, "xla") is not a
+
+
 def test_degenerate_equality_like(rng):
     # Paired inequalities pin z1 = 0.3 exactly (empty interior): the IPM
     # must still converge (infeasible-start handles degenerate geometry).

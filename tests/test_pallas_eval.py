@@ -21,6 +21,56 @@ def built():
     return prob, res, table
 
 
+def _synthetic_table(rng, L=40, p=2):
+    """A tiny LeafTable built directly from random simplices -- no
+    partition build, so this smoke stays tier-1-cheap even if the
+    build-backed module fixture ever migrates to the slow tier.  CPU
+    CI must always exercise at least one REAL Pallas lowering path
+    end-to-end (interpret mode; the same code Mosaic-compiles on
+    TPU)."""
+    from explicit_hybrid_mpc_tpu.partition import geometry
+
+    base = np.vstack([np.zeros(p), np.eye(p)])  # unit corner simplex
+    side = int(np.ceil(np.sqrt(L)))
+    bary, U, V = [], [], []
+    for i in range(L):
+        # Disjoint cells on a unit grid: each simplex is uniquely the
+        # best container of its own centroid, so location is exact and
+        # the f32 kernel must agree with the f64 reference on ids.
+        off = np.array([i % side, i // side], dtype=float)[:p]
+        verts = 0.8 * base + off + 0.1 * rng.uniform(size=p)
+        bary.append(geometry.barycentric_matrix(verts))
+        U.append(rng.normal(size=(p + 1, 1)))
+        V.append(np.abs(rng.normal(size=p + 1)))
+    return export.LeafTable(
+        bary_M=np.stack(bary), U=np.stack(U), V=np.stack(V),
+        delta=np.zeros(L, dtype=np.int64),
+        node_id=np.arange(L, dtype=np.int64))
+
+
+def test_locate_smoke_synthetic_vs_f64_evaluator(rng):
+    """Tier-1 interpret-mode smoke: the Pallas locate kernel against
+    the f64 pure-JAX evaluator on a synthetic table, no build."""
+    table = _synthetic_table(rng)
+    pt = pallas_eval.stage_pallas(table)
+    dev = evaluator.stage(table)
+    # Query AT the simplex centroids: every query is inside its own
+    # leaf, so the reference argmax is well-separated and the f32
+    # kernel must agree on ids, not just values.
+    cents = np.stack([np.linalg.inv(table.bary_M[i])[:-1, :].mean(axis=1)
+                      for i in range(table.n_leaves)])
+    ref = evaluator.evaluate(dev, jnp.asarray(cents))
+    out = pallas_eval.evaluate(pt, dev, jnp.asarray(cents),
+                               interpret=True)
+    np.testing.assert_allclose(np.asarray(out.u), np.asarray(ref.u),
+                               atol=1e-5)
+    np.testing.assert_allclose(np.asarray(out.cost),
+                               np.asarray(ref.cost), rtol=1e-5,
+                               atol=1e-5)
+    assert np.array_equal(np.asarray(out.leaf), np.asarray(ref.leaf))
+    assert bool(np.all(np.asarray(out.inside)))
+
+
 def test_stage_pallas_padding(built):
     _, _, table = built
     pt = pallas_eval.stage_pallas(table)

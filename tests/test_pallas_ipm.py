@@ -1,0 +1,269 @@
+"""Fused Pallas IPM micro-kernel (oracle/pallas_ipm.py) vs the XLA
+reference path, in interpret mode on CPU (on TPU the same kernel
+compiles via Mosaic for the f32 leg).
+
+The parity contract (docs/perf.md "IPM kernel"): converged/feasible
+masks bitwise-equal across tiers on every program family, iterates to
+tight tolerance, `schedule_iters` accounting exact under the kernel
+tier, and a full tier-1 build tree-identical.  The XLA path is the
+semantic reference; these tests are what lets the pallas tier ship as
+a dispatch tier instead of a fork of the solver.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import explicit_hybrid_mpc_tpu  # noqa: F401  (enables x64)
+from explicit_hybrid_mpc_tpu.config import PartitionConfig
+from explicit_hybrid_mpc_tpu.oracle import ipm, pallas_ipm
+from explicit_hybrid_mpc_tpu.oracle.oracle import Oracle
+from explicit_hybrid_mpc_tpu.partition import geometry
+from explicit_hybrid_mpc_tpu.partition.frontier import build_partition
+from explicit_hybrid_mpc_tpu.problems.registry import make
+
+
+def _qp_batch(rng, K=21, nz=8, nc=20, infeasible_every=3):
+    """Random strictly-feasible QPs with a sprinkling of infeasible
+    instances (contradictory row pair) so the not-converged /
+    not-feasible classification path is exercised too."""
+    Qs, qs, As, bs = [], [], [], []
+    for i in range(K):
+        W = rng.normal(size=(nz, nz))
+        Qs.append(W @ W.T + np.eye(nz))
+        qs.append(rng.normal(size=nz))
+        A = rng.normal(size=(nc, nz))
+        b = np.abs(rng.normal(size=nc)) + 0.5
+        if infeasible_every and i % infeasible_every == 0:
+            A[0] = -A[1]           # A1 z <= b1 and A1 z >= b1 + 1:
+            b[0] = -b[1] - 1.0     # contradictory pair, empty set
+        As.append(A)
+        bs.append(b)
+    return tuple(jnp.asarray(np.stack(x)) for x in (Qs, qs, As, bs))
+
+
+def _solve(tier, Qs, qs, As, bs, **kw):
+    return jax.jit(jax.vmap(functools.partial(
+        ipm.qp_solve, kernel=tier, **kw)))(Qs, qs, As, bs)
+
+
+def test_point_family_mask_and_iterate_parity():
+    rng = np.random.default_rng(11)
+    Qs, qs, As, bs = _qp_batch(rng)
+    for kw in (dict(n_iter=20), dict(n_iter=8, n_f32=15)):
+        ref = _solve("xla", Qs, qs, As, bs, **kw)
+        pal = _solve("pallas", Qs, qs, As, bs, **kw)
+        assert bool((ref.converged == pal.converged).all()), kw
+        assert bool((ref.feasible == pal.feasible).all()), kw
+        conv = np.asarray(ref.converged)
+        # Iterates to tight tolerance on the converged population (the
+        # diverging iterates of infeasible QPs are unstable by nature).
+        np.testing.assert_allclose(np.asarray(pal.z)[conv],
+                                   np.asarray(ref.z)[conv], atol=1e-9)
+        np.testing.assert_allclose(np.asarray(pal.obj)[conv],
+                                   np.asarray(ref.obj)[conv],
+                                   rtol=1e-9, atol=1e-9)
+        assert ref.converged.any() and not ref.converged.all()
+
+
+def test_warm_start_gate_parity():
+    """The merit-gated warm path runs OUTSIDE the legs (shared code):
+    warm_ok decisions and warm-started results must agree across
+    tiers."""
+    rng = np.random.default_rng(5)
+    Qs, qs, As, bs = _qp_batch(rng, K=13)
+    base = _solve("xla", Qs, qs, As, bs, n_iter=20)
+    warm = (base.z, base.s, base.lam,
+            jnp.asarray(np.arange(13) % 2 == 0))  # half the donors valid
+
+    def wsolve(tier):
+        return jax.jit(jax.vmap(
+            lambda Q, q, A, b, z, s, lam, h: ipm.qp_solve(
+                Q, q, A, b, n_iter=6, warm_start=(z, s, lam, h),
+                kernel=tier)))(Qs, qs, As, bs, *warm)
+
+    ref, pal = wsolve("xla"), wsolve("pallas")
+    assert bool((ref.warm_ok == pal.warm_ok).all())
+    assert bool((ref.converged == pal.converged).all())
+    assert ref.warm_ok.any()
+
+
+def test_unbatched_call_uses_reference_body():
+    """The custom_vmap fallback: an unbatched qp_solve (the serial
+    baseline's program shape) is the XLA body bit-for-bit even under
+    kernel='pallas'."""
+    rng = np.random.default_rng(2)
+    Qs, qs, As, bs = _qp_batch(rng, K=1, infeasible_every=0)
+    ref = jax.jit(functools.partial(ipm.qp_solve, kernel="xla"))(
+        Qs[0], qs[0], As[0], bs[0])
+    pal = jax.jit(functools.partial(ipm.qp_solve, kernel="pallas"))(
+        Qs[0], qs[0], As[0], bs[0])
+    assert np.array_equal(np.asarray(ref.z), np.asarray(pal.z))
+    assert bool(ref.converged) == bool(pal.converged)
+
+
+def test_solve_tiles_padding_and_tile_pick():
+    # Non-multiple batch sizes pad with benign identity QPs and slice
+    # them back off; small batches shrink the tile instead of padding
+    # 4x; the VMEM guard caps the tile for big shapes.
+    rng = np.random.default_rng(3)
+    Qs, qs, As, bs = _qp_batch(rng, K=11, infeasible_every=0)
+    z = jnp.zeros((11, 8))
+    s = jnp.ones((11, 20))
+    lam = jnp.ones((11, 20))
+    out = pallas_ipm.solve_tiles(Qs, qs, As, bs, z, s, lam, n_iter=5)
+    assert out[0].shape == (11, 8)
+    assert all(bool(jnp.all(jnp.isfinite(o))) for o in out)
+    assert pallas_ipm._pick_tile(2, 8, 20, 8) == 2
+    assert pallas_ipm._pick_tile(100, 8, 20, 8) == pallas_ipm.TILE
+    # A shape whose 8-wide working set exceeds the budget shrinks...
+    mid = pallas_ipm._pick_tile(64, 48, 128, 8)
+    assert 1 <= mid < pallas_ipm.TILE
+    assert pallas_ipm.tile_vmem_bytes(mid, 48, 128,
+                                      8) <= pallas_ipm.VMEM_BUDGET
+    # ...down to the 1-QP floor for shapes that can never fit.
+    assert pallas_ipm._pick_tile(64, 96, 512, 8) == 1
+
+
+def test_resolve_tier_and_forced_xla():
+    assert pallas_ipm.resolve_kernel_tier("auto") == "xla"  # CPU host
+    assert pallas_ipm.resolve_kernel_tier("pallas") == "pallas"
+    with pytest.raises(ValueError, match="ipm_kernel"):
+        pallas_ipm.resolve_kernel_tier("mosaic")
+    with pytest.raises(ValueError, match="ipm_kernel"):
+        PartitionConfig(problem="double_integrator",
+                        ipm_kernel="mosaic")
+    prob = make("double_integrator", N=3, theta_box=1.5)
+    assert Oracle(prob, backend="serial",
+                  ipm_kernel="pallas").ipm_kernel == "xla"
+    assert Oracle(prob, backend="cpu").ipm_kernel == "xla"  # auto/CPU
+
+
+@pytest.fixture(scope="module")
+def di_problem():
+    return make("double_integrator", N=3, theta_box=1.5)
+
+
+@pytest.fixture(scope="module")
+def tier_oracles(di_problem):
+    """Warm-capable two-phase oracles on both tiers (the shipping
+    configuration of the tier-1 build)."""
+    mk = lambda tier: Oracle(di_problem, backend="cpu", two_phase=True,  # noqa: E731
+                             warm_start=True, ipm_kernel=tier)
+    return mk("xla"), mk("pallas")
+
+
+def test_oracle_vertex_masks_and_exact_accounting(di_problem,
+                                                  tier_oracles):
+    """Two-phase cohort flow through the kernel tier: conv/feas masks
+    and the d* reduction bitwise-equal, and the host iteration ledger
+    (the exactness contract behind oracle.ipm_iters /
+    wasted_iter_frac) IDENTICAL across tiers -- cohort survivor sets
+    included."""
+    ox, op = tier_oracles
+    rng = np.random.default_rng(17)
+    thetas = rng.uniform(di_problem.theta_lb, di_problem.theta_ub,
+                         size=(23, di_problem.n_theta))
+    sx = ox.solve_vertices(thetas)
+    sp = op.solve_vertices(thetas)
+    assert np.array_equal(sx.conv, sp.conv)
+    assert np.array_equal(sx.feas, sp.feas)
+    assert np.array_equal(sx.dstar, sp.dstar)
+    fin = np.isfinite(sx.V)
+    np.testing.assert_allclose(sp.V[fin], sx.V[fin], rtol=1e-9,
+                               atol=1e-9)
+    assert ox.stat_snapshot() == op.stat_snapshot()
+    assert op.n_iters_f64 > 0
+
+
+def test_oracle_simplex_and_farkas_parity(di_problem, tier_oracles):
+    """Elastic-simplex-min (two-phase cohort) and the sound
+    Farkas/phase-1 program: encoding classes, feasibility witnesses,
+    and infeasibility certificates bitwise-equal across tiers."""
+    ox, op = tier_oracles
+    rng = np.random.default_rng(23)
+    Ms = np.stack([geometry.barycentric_matrix(
+        rng.uniform(di_problem.theta_lb, di_problem.theta_ub,
+                    size=(di_problem.n_theta + 1, di_problem.n_theta)))
+        for _ in range(9)])
+    ds = rng.integers(0, di_problem.canonical.n_delta, size=9)
+    vx, fx = ox.solve_simplex_min(Ms, ds)
+    vp, fp = op.solve_simplex_min(Ms, ds)
+
+    def cls(v):
+        return np.where(np.isposinf(v), 1, np.where(np.isneginf(v),
+                                                    -1, 0))
+
+    assert np.array_equal(cls(vx), cls(vp))
+    assert np.array_equal(fx, fp)
+    both = np.isfinite(vx) & np.isfinite(vp)
+    np.testing.assert_allclose(vp[both], vx[both], rtol=1e-8, atol=1e-8)
+    tx, feasx, infx = ox.simplex_feasibility(Ms, ds)
+    tp, feasp, infp = op.simplex_feasibility(Ms, ds)
+    assert np.array_equal(feasx, feasp)
+    assert np.array_equal(infx, infp)
+    np.testing.assert_allclose(tp, tx, atol=1e-10)
+
+
+def test_solve_mask_kernel_tier():
+    """The bare-kernel replay probe (scripts/replay_solve.py
+    --kernel-only --kernel-tier) agrees across tiers."""
+    rng = np.random.default_rng(29)
+    Qs, qs, As, bs = _qp_batch(rng, K=10)
+    cx, fx, rx = ipm.solve_mask(Qs, qs, As, bs, n_iter=15)
+    cp, fp, rp = ipm.solve_mask(Qs, qs, As, bs, n_iter=15,
+                                kernel="pallas")
+    assert np.array_equal(cx, cp) and np.array_equal(fx, fp)
+    fin = np.isfinite(rx) & np.isfinite(rp)
+    np.testing.assert_allclose(rp[fin], rx[fin], rtol=1e-6, atol=1e-12)
+
+
+def _tree_signature(res):
+    """Node-for-node structural identity (same contract as
+    tests/test_pipeline.py): vertex matrices bitwise, leaf
+    commutations and certification statuses, region/node counts."""
+    tree = res.tree
+    leaves = tree.converged_leaves()
+    return (res.stats["regions"], res.stats["tree_nodes"],
+            res.stats["uncertified"], res.stats["semi_explicit"],
+            tuple(tree.vertices[n].tobytes() for n in range(len(tree))),
+            tuple(tree.leaf_data[n].delta_idx for n in leaves),
+            tuple(bool(tree.leaf_data[n].certified) for n in leaves))
+
+
+def test_full_build_tree_identical_across_tiers(di_problem):
+    """Acceptance: a full tier-1 build with ipm_kernel='pallas'
+    (interpret) produces the IDENTICAL tree to 'xla' -- every program
+    family, the cohort compaction, warm-start donors, and the
+    certificates all flow through the kernel tier."""
+    def build(tier):
+        cfg = PartitionConfig(problem="double_integrator", eps_a=0.5,
+                              backend="cpu", batch_simplices=64,
+                              max_depth=20, ipm_kernel=tier)
+        return build_partition(di_problem, cfg)
+
+    rx, rp = build("xla"), build("pallas")
+    assert rx.stats["regions"] > 50
+    assert _tree_signature(rx) == _tree_signature(rp)
+
+
+def test_obs_kernel_gauge_and_tile_histogram(di_problem):
+    from explicit_hybrid_mpc_tpu import obs as obs_lib
+
+    rng = np.random.default_rng(31)
+    thetas = rng.uniform(di_problem.theta_lb, di_problem.theta_ub,
+                         size=(5, di_problem.n_theta))
+    for tier, want in (("pallas", 1.0), ("xla", 0.0)):
+        obs = obs_lib.Obs("jsonl")
+        o = Oracle(di_problem, backend="cpu", ipm_kernel=tier, obs=obs)
+        o.solve_vertices(thetas)
+        summ = obs.metrics.summary()
+        assert summ["gauges"]["oracle.ipm_kernel"] == want
+        hist = summ.get("histograms", {}).get("oracle.ipm_kernel_tile_s")
+        if tier == "pallas":
+            assert hist is not None and hist["count"] > 0
+        else:
+            assert hist is None

@@ -54,6 +54,41 @@ def test_host_sync_positive():
     assert all(f.severity == "error" for f in msgs)
 
 
+def test_host_sync_positive_pallas_kernel_body():
+    """A Pallas kernel body is a traced (then Mosaic-lowered) region:
+    functions passed to pl.pallas_call index as jit regions, so the
+    host-sync rule covers them (oracle/pallas_ipm.py,
+    online/pallas_eval.py)."""
+    found = lint("""
+        import jax
+        from jax.experimental import pallas as pl
+
+        def _kernel(x_ref, o_ref):
+            v = float(x_ref[0])     # host cast inside the kernel
+            o_ref[:] = x_ref[:] + v
+
+        def launch(x):
+            return pl.pallas_call(
+                _kernel, out_shape=x)(x)
+    """)
+    assert "host-sync-in-jit" in rule_ids(found)
+
+
+def test_host_sync_negative_pallas_host_helper():
+    # The same cast in a plain host helper of the same module: clean.
+    found = lint("""
+        from jax.experimental import pallas as pl
+
+        def _kernel(x_ref, o_ref):
+            o_ref[:] = x_ref[:]
+
+        def host_stage(x):
+            scale = float(x.sum())
+            return pl.pallas_call(_kernel, out_shape=x)(x), scale
+    """)
+    assert "host-sync-in-jit" not in rule_ids(found)
+
+
 def test_host_sync_negative_host_code_free():
     # The SAME calls outside any jit region are plain numpy: clean.
     found = lint("""

@@ -846,6 +846,148 @@ def run(result: dict, monitor: ContentionMonitor | None = None) -> None:
     result["metrics"] = build_obs.metrics.summary()
 
 
+def run_rebuild(result: dict, monitor=None) -> None:
+    """``bench.py --rebuild``: the incremental-warm-rebuild benchmark
+    (partition/rebuild.py).  Protocol: cold-build the flagship problem
+    at eps, perturb eps (BENCH_REBUILD_EPS_SCALE, default 0.9 --
+    tighter, so a realistic fraction of leaves invalidates), cold-build
+    the perturbed problem as the EQUAL-CERTIFICATION reference, then
+    warm-rebuild the perturbed problem from the prior tree.  Reports
+    ``rebuild_reuse_frac`` (kept / prior leaves),
+    ``rebuild_speedup`` (equal-eps cold wall / rebuild wall) and
+    ``recert_solves``; scripts/bench_gate.py gates the first two
+    higher-is-better.  BENCH_REBUILD_NUDGE="key=value" additionally
+    measures a problem-parameter nudge rebuild (reported, not gated;
+    default a=2.02 on the pendulum, "off" disables)."""
+    platform = choose_backend(result)
+    if monitor is not None:
+        monitor.start()
+    on_acc = platform != "cpu"
+
+    from explicit_hybrid_mpc_tpu import obs as obs_lib
+    from explicit_hybrid_mpc_tpu.config import PartitionConfig
+    from explicit_hybrid_mpc_tpu.oracle.oracle import Oracle
+    from explicit_hybrid_mpc_tpu.partition.frontier import build_partition
+    from explicit_hybrid_mpc_tpu.partition.rebuild import warm_rebuild
+    from explicit_hybrid_mpc_tpu.problems.registry import make, names
+
+    problem_name = ("inverted_pendulum" if "inverted_pendulum" in names()
+                    else "double_integrator")
+    problem_name = os.environ.get("BENCH_PROBLEM", problem_name)
+    problem = make(problem_name)
+    precision = os.environ.get("BENCH_PRECISION",
+                               default_precision(on_acc, problem))
+    eps = float(os.environ.get("BENCH_EPS", "1e-2"))
+    eps2 = eps * float(os.environ.get("BENCH_REBUILD_EPS_SCALE", "0.9"))
+    max_steps = int(os.environ.get("BENCH_MAX_STEPS",
+                                   "5000" if on_acc else "2000"))
+    time_budget = float(os.environ.get("BENCH_TIME_BUDGET",
+                                       "600" if on_acc else "240"))
+    batch = int(os.environ.get("BENCH_BATCH", "512" if on_acc else "256"))
+    points_cap = int(os.environ.get("BENCH_POINTS_CAP",
+                                    "2048" if on_acc else "256"))
+    result["metric"] = (
+        f"warm-rebuild reuse/speedup ({problem_name}, eps {eps:g} -> "
+        f"{eps2:g}, {platform}, {precision} precision)")
+
+    sched_kw = schedule_kwargs(result)
+    oracle = Oracle(problem, backend="device" if on_acc else "cpu",
+                    precision=precision, points_cap=points_cap,
+                    **sched_kw)
+    warm_reserve = 3 * time_budget + 120.0
+    warm_oracle(oracle, problem, stop_after=deadline() - warm_reserve)
+    log("warmup build (simplex-query programs)...")
+    warm_cfg = PartitionConfig(problem=problem_name, eps_a=1.0,
+                               backend="device", batch_simplices=batch,
+                               max_steps=50, time_budget_s=120.0)
+    build_partition(problem, warm_cfg, oracle=oracle)
+    oracle.reset_stats()
+
+    max_depth = int(os.environ.get("BENCH_MAX_DEPTH", "56"))
+
+    def _cfg(e: float) -> PartitionConfig:
+        remaining = deadline() - time.time() - 60.0
+        return PartitionConfig(
+            problem=problem_name, eps_a=e, backend="device",
+            batch_simplices=batch, max_steps=max_steps,
+            precision=precision, max_depth=max_depth,
+            time_budget_s=max(60.0, min(time_budget, remaining)))
+
+    log(f"prior cold build (eps {eps:g})...")
+    res_a = build_partition(problem, _cfg(eps), oracle=oracle)
+    result.update(rebuild_prior_regions=res_a.stats["regions"],
+                  rebuild_prior_wall_s=round(res_a.stats["wall_s"], 2))
+    log(f"prior: {res_a.stats['regions']} regions in "
+        f"{res_a.stats['wall_s']:.1f}s")
+
+    log(f"equal-eps cold reference (eps {eps2:g})...")
+    oracle.reset_stats()
+    res_b = build_partition(problem, _cfg(eps2), oracle=oracle)
+    cold_wall = res_b.stats["wall_s"]
+    result.update(rebuild_cold_wall_s=round(cold_wall, 2),
+                  rebuild_cold_regions=res_b.stats["regions"],
+                  rebuild_cold_uncertified=res_b.stats["uncertified"])
+    log(f"cold reference: {res_b.stats['regions']} regions in "
+        f"{cold_wall:.1f}s")
+
+    log(f"warm rebuild (eps {eps:g} -> {eps2:g})...")
+    build_obs = obs_lib.Obs("jsonl")
+    oracle.reset_stats()
+    res_c = warm_rebuild(problem, _cfg(eps2), res_a.tree,
+                         oracle=oracle, obs=build_obs)
+    st = res_c.stats
+    speedup = cold_wall / max(st["rebuild_wall_s"], 1e-9)
+    result["metrics"] = build_obs.metrics.summary()
+    result.update(
+        rebuild_reuse_frac=st["rebuild_reuse_frac"],
+        rebuild_speedup=round(speedup, 2),
+        recert_solves=st["recert_solves"],
+        subdivision_solves=st["subdivision_solves"],
+        rebuild_invalidated=st["rebuild_leaves_invalidated"],
+        rebuild_wall_s=st["rebuild_wall_s"],
+        sweep_wall_s=st["sweep_wall_s"],
+        regions=st["regions"],
+        uncertified=st["uncertified"],
+        truncated=(st["truncated"] or res_b.stats["truncated"]
+                   or res_a.stats["truncated"]),
+        device_failures=st["device_failures"],
+        warm_start_tree=getattr(oracle, "warm_start", False),
+        ipm_kernel=getattr(oracle, "ipm_kernel", "xla"))
+    log(f"rebuild: reuse {st['rebuild_reuse_frac']:.3f}, "
+        f"{st['recert_solves']} recert + {st['subdivision_solves']} "
+        f"subdivision solves, wall {st['rebuild_wall_s']:.1f}s -> "
+        f"speedup {speedup:.2f}x vs equal-eps cold")
+
+    # Optional problem-parameter nudge rebuild (reported, not gated):
+    # the same prior tree re-certified against a perturbed PLANT at the
+    # original eps -- the model-revision reuse story, whereas the
+    # headline above is the eps-revision one.
+    nudge = os.environ.get("BENCH_REBUILD_NUDGE")
+    if nudge is None and problem_name == "inverted_pendulum":
+        nudge = "a=2.02"
+    if nudge and nudge != "off" and "=" in nudge:
+        try:
+            k, v = nudge.split("=", 1)
+            problem2 = make(problem_name, **{k: json.loads(v)})
+            oracle2 = Oracle(problem2,
+                             backend="device" if on_acc else "cpu",
+                             precision=precision, points_cap=points_cap,
+                             **sched_kw)
+            res_n = warm_rebuild(problem2, _cfg(eps), res_a.tree,
+                                 oracle=oracle2)
+            result.update(
+                rebuild_nudge=nudge,
+                rebuild_nudge_reuse_frac=res_n.stats[
+                    "rebuild_reuse_frac"],
+                rebuild_nudge_wall_s=res_n.stats["rebuild_wall_s"],
+                rebuild_nudge_uncertified=res_n.stats["uncertified"])
+            log(f"nudge ({nudge}): reuse "
+                f"{res_n.stats['rebuild_reuse_frac']:.3f} in "
+                f"{res_n.stats['rebuild_wall_s']:.1f}s")
+        except Exception as e:  # the headline numbers already shipped
+            log(f"nudge rebuild skipped: {e!r}")
+
+
 def large_l_metrics(result: dict, obs=None) -> None:
     """BENCH_LARGE_DEPTH (0 disables) controls the synthetic tree depth
     (leaves = p! * 2**depth over the unit box); BENCH_LARGE_P the
@@ -976,16 +1118,30 @@ def hold_sentinel():
     return stop
 
 
-def main() -> int:
-    result: dict = {"metric": "offline regions/sec", "value": None,
-                    "unit": "regions/s", "vs_baseline": None}
+def main(argv: list[str] | None = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    # --rebuild (or BENCH_REBUILD=1): the warm-rebuild benchmark mode.
+    # Its rows carry rebuild_* gated metrics and NO "value", so the
+    # bench_gate trailing windows never mix it with build rows.
+    rebuild_mode = ("--rebuild" in argv
+                    or os.environ.get("BENCH_REBUILD") == "1")
+    if rebuild_mode:
+        result: dict = {"metric": "warm-rebuild reuse/speedup",
+                        "rebuild_reuse_frac": None,
+                        "rebuild_speedup": None}
+    else:
+        result = {"metric": "offline regions/sec", "value": None,
+                  "unit": "regions/s", "vs_baseline": None}
     release = hold_sentinel()
     # Late-bound class (module __getattr__ is not consulted for bare
     # globals inside functions): the jax-importing package loads only
     # here, inside the guard.
     monitor = _contention_monitor_cls()()
     try:
-        run(result, monitor)
+        if rebuild_mode:
+            run_rebuild(result, monitor)
+        else:
+            run(result, monitor)
     except BaseException as e:
         result["error"] = repr(e)
         traceback.print_exc(file=sys.stderr)
@@ -1019,7 +1175,9 @@ def main() -> int:
         # effort: history is observability, and the un-killable
         # contract forbids it to fail the capture.
         hist_path = os.environ.get("BENCH_HISTORY")
-        if result.get("value") is not None and hist_path != "":
+        produced = (result.get("value") is not None
+                    or result.get("rebuild_speedup") is not None)
+        if produced and hist_path != "":
             try:
                 sys.path.insert(0, os.path.join(
                     os.path.dirname(os.path.abspath(__file__)),
@@ -1039,7 +1197,7 @@ def main() -> int:
                            if out_path else round(T_START, 3)))
             except Exception as e:
                 log(f"bench history append skipped: {e!r}")
-    return 0 if result.get("value") is not None else 1
+    return 0 if produced else 1
 
 
 if __name__ == "__main__":

@@ -251,6 +251,18 @@ class PartitionConfig:
     # stream.  scripts/obs_watch.py applies the same schema to a live
     # stream from outside the process.
     health_rules: tuple = ()
+    # Incremental warm rebuild (partition/rebuild.py): path to a prior
+    # build's .tree.pkl or .ckpt.pkl.  When set, build_partition
+    # transfers the prior tree, re-certifies its leaves in bulk against
+    # THIS config's problem/eps/oracle, and subdivides only what the
+    # revision invalidated -- an unchanged problem rebuilds
+    # node-for-node bit-identical with zero subdivision solves.  CLI:
+    # the `rebuild` subcommand / --rebuild-from.  None = cold build.
+    rebuild_from: Optional[str] = None
+    # Refuse rebuild priors that carry no provenance stamp (legacy
+    # artifacts cannot be validated against the revision); the default
+    # shims them with a stats note.
+    rebuild_strict_provenance: bool = False
     # Runtime recompile sentinel (analysis/recompile_guard.py): once
     # the build has run a warmup of FULL-size batches (the compiled-
     # shape set is complete by then -- pow-2 padding bounds it), any

@@ -97,6 +97,23 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--dedup-window", type=int, default=None, metavar="K",
                    help="max in-flight vertices tracked for cross-batch "
                    "solve dedup (default 8192)")
+    p.add_argument("--rebuild-from", "--from", dest="rebuild_from",
+                   metavar="PRIOR", default=None,
+                   help="incremental warm rebuild (partition/rebuild.py"
+                        "): transfer PRIOR (.tree.pkl or .ckpt.pkl), "
+                        "bulk re-certify its leaves against THIS "
+                        "problem/eps, and subdivide only what the "
+                        "revision invalidated (the `rebuild` "
+                        "subcommand implies this flag)")
+    p.add_argument("--strict-provenance", action="store_true",
+                   help="refuse rebuild priors without a provenance "
+                        "stamp (legacy artifacts otherwise shim with a "
+                        "stats note)")
+    p.add_argument("--artifacts-out", metavar="DIR", default=None,
+                   help="additionally export the built tree as a "
+                        "provenance-stamped serving artifact directory "
+                        "(serve/registry.save_artifacts layout; deploy "
+                        "with `main serve --artifacts DIR`)")
     p.add_argument("--max-steps", type=int, default=10_000)
     p.add_argument("--checkpoint-every", type=int, default=0, metavar="K",
                    help="snapshot frontier+tree every K steps")
@@ -196,7 +213,20 @@ def main(argv: list[str] | None = None) -> int:
         from explicit_hybrid_mpc_tpu.serve.cli import serve_main
 
         return serve_main(argv[1:])
+    # `rebuild` is sugar over the build surface: same parser, --from
+    # required (docs/perf.md "Incremental warm rebuild").
+    rebuild_cmd = bool(argv) and argv[0] == "rebuild"
+    if rebuild_cmd:
+        argv = argv[1:]
     args = build_parser().parse_args(argv)
+    if rebuild_cmd and not args.rebuild_from:
+        raise SystemExit("rebuild: --from PRIOR (a .tree.pkl or "
+                         ".ckpt.pkl) is required")
+    if args.rebuild_from and args.resume:
+        raise SystemExit("--rebuild-from and --resume are exclusive: "
+                         "resume continues ONE build mid-flight, "
+                         "rebuild starts a NEW build from a prior "
+                         "tree's certificates")
 
     from explicit_hybrid_mpc_tpu.problems.registry import make, names
     if args.list:
@@ -276,7 +306,9 @@ def main(argv: list[str] | None = None) -> int:
         recorder_dir=(args.recorder_dir or f"{prefix}.repro"
                       if args.recorder or args.recorder_dir else None),
         health_rules=_parse_health_rules(args.health_rule),
-        recompile_guard=args.recompile_guard or "off")
+        recompile_guard=args.recompile_guard or "off",
+        rebuild_from=args.rebuild_from,
+        rebuild_strict_provenance=args.strict_provenance)
 
     if snapshot is not None:
         # SOLVER flags (precision/backend/eps/batch...) come from the
@@ -378,14 +410,33 @@ def main(argv: list[str] | None = None) -> int:
     log = RunLog(cfg.log_path, echo=True)
     if args.resume:
         eng = FrontierEngine.resume(snapshot, problem, oracle, log, cfg=cfg)
+        res = eng.run()
+    elif cfg.rebuild_from:
+        from explicit_hybrid_mpc_tpu.partition.provenance import (
+            ProvenanceMismatch)
+        from explicit_hybrid_mpc_tpu.partition.rebuild import (
+            RebuildError, warm_rebuild)
+
+        try:
+            res = warm_rebuild(
+                problem, cfg, cfg.rebuild_from, oracle=oracle, log=log,
+                strict_provenance=cfg.rebuild_strict_provenance)
+        except (RebuildError, ProvenanceMismatch) as e:
+            raise SystemExit(f"rebuild: {e}")
     else:
         eng = FrontierEngine(problem, oracle, cfg, log)
-    res = eng.run()
+        res = eng.run()
 
     res.tree.save(f"{prefix}.tree.pkl")
     with open(f"{prefix}.stats.json", "w") as f:
         json.dump(res.stats, f, indent=2)
     print(json.dumps(res.stats), file=sys.stderr)
+    if args.artifacts_out:
+        from explicit_hybrid_mpc_tpu.serve.registry import save_artifacts
+
+        save_artifacts(res.tree, res.roots, args.artifacts_out)
+        print(f"serving artifacts written to {args.artifacts_out}",
+              file=sys.stderr)
 
     if args.simulate:
         import numpy as np

@@ -48,6 +48,18 @@ Rule schema (all values floats; 0 disables a threshold rule):
                            (serve.ctl.<name>.fallback_frac gauges) ->
                            ``health.fallback_frac`` (warn) -- the
                            serving SLO from docs/serving.md
+``min_rebuild_reuse``      warm-rebuild reuse_frac floor
+                           (rebuild.reuse_frac gauge, volume-gated on
+                           ``min_rebuild_leaves`` prior leaves -- its
+                           OWN gate, in leaves, not the solve-count
+                           knob) -> ``health.rebuild_reuse_collapse``
+                           (warn): a near-zero reuse on a large prior
+                           tree signals a silently-drifted problem
+                           hash -- the rebuild is paying cold-build
+                           cost while reporting warm; 0 = off
+``min_rebuild_leaves``     prior-leaf volume floor for the rule above
+                           (a tiny prior legitimately invalidates
+                           wholesale)
 ``min_solves_for_rates``   rate rules stay silent below this volume
 ``metrics_every_steps``    engine-side feed cadence (frontier.py)
 =========================  =============================================
@@ -82,6 +94,8 @@ DEFAULT_RULES: dict[str, float] = {
     "max_device_failures": 3.0,
     "serve_p99_us": 0.0,
     "fallback_frac": 0.25,
+    "min_rebuild_reuse": 0.2,
+    "min_rebuild_leaves": 500.0,
     "min_solves_for_rates": 2000.0,
     "metrics_every_steps": 100.0,
 }
@@ -325,6 +339,31 @@ class HealthMonitor:
                            "traffic has left the certified box or the "
                            "tree has holes -- rebuild or widen the "
                            "partition", key=f"fallback_frac:{ctl}")
+
+        # Warm-rebuild reuse collapse: a near-zero reuse fraction on a
+        # LARGE prior tree means the revision invalidated (almost)
+        # everything -- most often a silently-drifted problem hash
+        # (wrong prior artifact, unnoticed model change), i.e. the
+        # rebuild pays cold-build cost while the operator believes it
+        # is warm.  Volume-gated on its OWN leaf-count floor
+        # (min_rebuild_leaves) -- the min_solves_for_rates knob is in
+        # SOLVES and would silently disable this rule for mid-size
+        # trees (and retune it whenever the solve knob moves).
+        lim = self.rules["min_rebuild_reuse"]
+        reuse = gauges.get("rebuild.reuse_frac")
+        n_leaves = (counters.get("rebuild.leaves_reused", 0)
+                    + counters.get("rebuild.leaves_invalidated", 0))
+        if lim > 0 and reuse is not None \
+                and n_leaves >= self.rules["min_rebuild_leaves"] \
+                and reuse < lim:
+            self._fire("rebuild_reuse_collapse", "warn", round(reuse, 4),
+                       lim,
+                       f"warm rebuild reused {100 * reuse:.1f}% of "
+                       f"{n_leaves:.0f} prior leaves (< {100 * lim:.0f}"
+                       "%): the revision invalidated nearly everything "
+                       "-- check the prior artifact's provenance stamp "
+                       "(a drifted problem hash makes every "
+                       "certificate fail)")
 
         lim = self.rules["max_competing_cpu_frac"]
         host = gauges.get("host.competing_cpu_frac_mean")

@@ -103,6 +103,30 @@ def _field_dtype(name: str):
     return np.int32 if name in ("delta", "node_id") else np.float64
 
 
+def _write_meta(dir_path: str, n_leaves: int, p: int, n_u: int,
+                provenance: dict | None) -> None:
+    """The table's ``meta.json``, including the build-provenance stamp
+    (partition/provenance.py) when one is known.  A stamp-less write is
+    legal (synthetic trees, tests) -- loaders then treat the table as
+    legacy/unstamped."""
+    meta = {"n_leaves": int(n_leaves), "p": int(p), "n_u": int(n_u)}
+    if provenance is not None:
+        meta["provenance"] = provenance
+    with open(os.path.join(dir_path, "meta.json"), "w") as f:
+        json.dump(meta, f)
+
+
+def load_table_provenance(dir_path: str) -> dict | None:
+    """The provenance stamp of an exported table directory, or None for
+    legacy/stamp-less tables (missing meta.json included -- the arrays
+    alone are still a loadable table)."""
+    try:
+        with open(os.path.join(dir_path, "meta.json")) as f:
+            return json.load(f).get("provenance")
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
 def export_leaves(tree: Tree, chunk: int = DEFAULT_CHUNK) -> LeafTable:
     """In-RAM export, chunk-streamed into one preallocated table.  (The
     per-leaf python loop this replaced built 3L small arrays in lists
@@ -118,12 +142,14 @@ def export_leaves(tree: Tree, chunk: int = DEFAULT_CHUNK) -> LeafTable:
 
 
 def write_leaf_table(tree: Tree, dir_path: str,
-                     chunk: int = DEFAULT_CHUNK) -> LeafTable:
+                     chunk: int = DEFAULT_CHUNK,
+                     provenance: dict | None = None) -> LeafTable:
     """Stream the leaf table into memory-mapped ``<dir>/<field>.npy``
     files; peak additional RSS is O(chunk), so a built tree can be
     exported next to itself without doubling host memory.  Returns the
     memmap-backed table (flushed; reopen with load_leaf_table for a
-    clean read-only mapping)."""
+    clean read-only mapping).  ``provenance`` defaults to the tree's
+    own build stamp and lands in ``meta.json``."""
     ids = _leaf_ids(tree)
     os.makedirs(dir_path, exist_ok=True)
     shapes = _field_shapes(tree, ids.size)
@@ -135,29 +161,42 @@ def write_leaf_table(tree: Tree, dir_path: str,
     _fill_chunks(tree, ids, out, chunk)
     for a in out:
         a.flush()
-    with open(os.path.join(dir_path, "meta.json"), "w") as f:
-        json.dump({"n_leaves": int(ids.size), "p": tree.p,
-                   "n_u": tree.n_u}, f)
+    if provenance is None:
+        provenance = getattr(tree, "provenance", None)
+    _write_meta(dir_path, ids.size, tree.p, tree.n_u, provenance)
     return out
 
 
-def save_leaf_table(table: LeafTable, dir_path: str) -> None:
+def save_leaf_table(table: LeafTable, dir_path: str,
+                    provenance: dict | None = None) -> None:
     """Persist an already-materialized table (same layout as
     write_leaf_table; prefer that for large trees -- it never holds the
     full table in RAM)."""
     os.makedirs(dir_path, exist_ok=True)
     for k in _LEAF_FIELDS:
         np.save(os.path.join(dir_path, f"{k}.npy"), getattr(table, k))
-    with open(os.path.join(dir_path, "meta.json"), "w") as f:
-        json.dump({"n_leaves": int(table.n_leaves),
-                   "p": int(table.bary_M.shape[1] - 1),
-                   "n_u": int(table.U.shape[2])}, f)
+    _write_meta(dir_path, table.n_leaves, table.bary_M.shape[1] - 1,
+                table.U.shape[2], provenance)
 
 
-def load_leaf_table(dir_path: str, mmap: bool = True) -> LeafTable:
+def load_leaf_table(dir_path: str, mmap: bool = True,
+                    expect_provenance: dict | None = None,
+                    strict: bool = False) -> LeafTable:
     """Load an exported table; ``mmap=True`` maps the files read-only
     (pages fault in on demand -- the online stage working set, not L,
-    bounds RSS), ``mmap=False`` reads full copies."""
+    bounds RSS), ``mmap=False`` reads full copies.
+
+    ``expect_provenance``: the build stamp the caller believes this
+    table carries (partition/provenance.build_stamp).  A mismatch warns
+    by default and raises ``ProvenanceMismatch`` under ``strict`` --
+    the guard against deploying/reusing a table against a revised
+    problem.  Legacy stamp-less tables warn and load."""
+    if expect_provenance is not None:
+        from explicit_hybrid_mpc_tpu.partition import provenance as prov
+
+        prov.check_stamp(load_table_provenance(dir_path),
+                         expect_provenance, where=dir_path,
+                         strict=strict)
     mode = "r" if mmap else None
     return LeafTable(*(np.load(os.path.join(dir_path, f"{k}.npy"),
                                mmap_mode=mode)

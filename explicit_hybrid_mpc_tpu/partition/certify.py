@@ -373,6 +373,84 @@ def certify_suboptimal_stage2(sd: SimplexVertexData, res: CertificateResult,
     return CertificateResult(status="split", gap=best[0] if best else np.inf)
 
 
+def recertify_stored_stage1(sd: SimplexVertexData, delta_idx: int,
+                            eps_a: float, eps_r: float
+                            ) -> CertificateResult:
+    """Re-certification of a leaf's ALREADY-STORED commutation against
+    fresh oracle data (the warm-rebuild keep-check, partition/rebuild).
+
+    Unlike certify_suboptimal_stage1 this fixes the candidate to the
+    leaf's stored ``delta_idx`` -- the question is not "can some law
+    certify here" but "does the law this leaf already serves still
+    carry its eps-certificate".  The bound mathematics is identical
+    (same U from the stored delta's vertex costs, same tangent lower
+    envelope over every commutation), so a pass is exactly as sound as
+    the cold build's certificate; the stored delta need not be
+    vertex-optimal under the revised problem for the pass to be valid
+    (any delta converged at every vertex defines a valid U).
+
+    Outcomes: 'certified' (keep the leaf untouched), 'split'
+    (invalidated -- re-open into the frontier), or 'pending' with
+    ``pending_deltas`` (stage-2 simplex bounds needed; complete via
+    certify_suboptimal_stage2, which accepts this result's
+    single-candidate ``_candidates``/``_stage1_gap`` directly)."""
+    d = int(delta_idx)
+    if d < 0 or not bool(np.all(sd.conv[:, d])):
+        # Stored law no longer converges at every vertex: U is not a
+        # valid upper bound anywhere on R -- certificate gone.
+        return CertificateResult(status="split")
+    U = sd.V[:, d]
+    gaps = tangent_gaps(sd, U)
+    nan = np.isnan(gaps)
+    if np.any(nan):
+        return CertificateResult(
+            status="pending", pending_deltas=np.where(nan)[0],
+            _stage1_gap=gaps[None], _candidates=np.asarray([d]))
+    g = float(np.max(gaps))
+    if _passes(g, sd.Vstar, eps_a, eps_r):
+        return CertificateResult(
+            status="certified", delta_idx=d, vertex_inputs=sd.u0[:, d, :],
+            vertex_costs=sd.V[:, d], vertex_z=sd.z[:, d, :], gap=g)
+    return CertificateResult(status="split", gap=g)
+
+
+def recertify_stored_stage2(stage1_gaps: np.ndarray, U_max: float,
+                            Vstar: np.ndarray, Vmin: dict,
+                            eps_a: float, eps_r: float
+                            ) -> tuple[bool, float]:
+    """Complete a recertify_stored_stage1 'pending' verdict with
+    stage-2 lower bounds; returns (passes, gap).
+
+    Same bound algebra as certify_suboptimal_stage2 restricted to the
+    single stored candidate: a NaN stage-1 gap (delta' converged at no
+    vertex) is replaced by ``U_max - Vmin[dp]`` (+inf Vmin = certified
+    exclusion contributes -inf; -inf Vmin = stalled solve contributes
+    +inf, conservatively blocking the keep).  ``Vmin`` entries may be
+    ANCESTOR-simplex bounds (warm rebuild lifts stage-2 solves up the
+    tree): a lower bound on a superset is a lower bound on the leaf,
+    so a PASS is sound with loose bounds -- a FAIL is inconclusive and
+    the caller re-solves exactly, mirroring the frontier's round A/B."""
+    g = -np.inf
+    for dp in range(stage1_gaps.size):
+        if np.isnan(stage1_gaps[dp]):
+            lo = Vmin[dp]
+            gd = -np.inf if lo == np.inf else float(U_max - lo)
+        else:
+            gd = float(stage1_gaps[dp])
+        g = max(g, gd)
+    return _passes(g, Vstar, eps_a, eps_r), g
+
+
+def recertify_infeasible(sd: SimplexVertexData) -> str:
+    """Vertex-level re-check of a closed INFEASIBLE leaf (warm rebuild):
+    'split' when any vertex became feasible under the revised problem
+    (the emptiness proof is void -- re-open), 'pending' otherwise (all
+    vertices still infeasible; the whole-simplex Farkas certificates
+    must be re-established per commutation, exactly as the cold build's
+    infeasible path does)."""
+    return "pending" if not np.any(sd.dstar >= 0) else "split"
+
+
 def certify_feasible(sd: SimplexVertexData) -> CertificateResult:
     """Feasibility-only ('feasible'/ECC) certification: a commutation
     feasible at every vertex is feasible on all of R (convexity); the leaf

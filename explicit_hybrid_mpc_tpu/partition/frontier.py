@@ -235,6 +235,12 @@ class FrontierEngine:
         self.tree = Tree(p=p, n_u=problem.n_u,
                          split_hyperplanes=getattr(
                              cfg, "split_hyperplanes", True))
+        # Build-provenance stamp (partition/provenance.py): rides the
+        # tree through every pickle/checkpoint/export so loaders and
+        # the warm-rebuild engine can detect problem/config drift.
+        from explicit_hybrid_mpc_tpu.partition import provenance as prov
+
+        self.tree.provenance = prov.build_stamp(problem, cfg)
         self.roots = [self.tree.add_root(V) for V in
                       geometry.box_triangulation(
                           problem.theta_lb, problem.theta_ub,
@@ -1090,6 +1096,24 @@ class FrontierEngine:
                         sds[n], results[n], vm_map[n], self.cfg.eps_a,
                         self.cfg.eps_r)
 
+        # Log fresh stage-2 facts into the tree's event ledger
+        # (tree.excl_events): the warm rebuild (partition/rebuild.py)
+        # re-VERIFIES exactly these (node, delta) certificates against
+        # a revised oracle and inherits the survivors down the tree --
+        # +inf rows are whole-simplex emptiness certificates (they mask
+        # descendant point cells and close pending commutations for
+        # free), finite rows are the simplex lower bounds descendant
+        # certifications passed with (re-solved lazily at the SAME
+        # node, shared by every descendant leaf).  Re-DISCOVERING
+        # either costs a joint QP per (leaf, pending commutation), the
+        # dominant sweep cost on hybrid problems.  -inf stalls carry no
+        # reusable fact and are not logged.
+        ev = self.tree.excl_events
+        for n2, fd in fresh.items():
+            for d2, v2 in fd.items():
+                if v2 == np.inf or np.isfinite(v2):
+                    ev.append((int(n2), int(d2), float(v2)))
+
         n_leaves = n_splits = 0
         store_z = getattr(self.cfg, "store_vertex_z", True)
         for n in nodes:
@@ -1439,6 +1463,10 @@ class FrontierEngine:
                 "n_inherited_skips": self.n_inherited_skips,
                 "n_point_skips": self.n_point_skips,
                 "cfg": self.cfg,
+                # Duplicates the tree's own stamp at the top level so a
+                # checkpoint's provenance is inspectable without paying
+                # the multi-hundred-MB tree unpickle.
+                "provenance": getattr(self.tree, "provenance", None),
             }, f, protocol=pickle.HIGHEST_PROTOCOL)
 
     @classmethod
@@ -1476,6 +1504,15 @@ class FrontierEngine:
         eng.cfg = cfg
         eng.log = log or RunLog(eng.cfg.log_path, echo=False)
         eng.tree = snap["tree"]
+        if getattr(eng.tree, "provenance", None) is None:
+            # Pre-stamp snapshot: back-fill from the EFFECTIVE resumed
+            # config (the snapshot's solver knobs -- see the cfg merge
+            # above), so artifacts exported after this resume carry a
+            # stamp going forward.
+            from explicit_hybrid_mpc_tpu.partition import (
+                provenance as prov)
+
+            eng.tree.provenance = prov.build_stamp(problem, cfg)
         eng.roots = snap["roots"]
         eng.frontier = collections.deque(snap["frontier"])
         eng.cache = VertexCache()
@@ -1586,8 +1623,21 @@ def make_oracle(problem, cfg: PartitionConfig, mesh=None,
 def build_partition(problem, cfg: PartitionConfig,
                     oracle: Oracle | None = None,
                     obs: "obs_lib.Obs | None" = None) -> PartitionResult:
-    """One-call offline build: problem + config -> certified partition."""
+    """One-call offline build: problem + config -> certified partition.
+
+    cfg.rebuild_from routes through the incremental warm rebuild
+    (partition/rebuild.py): the named prior tree/checkpoint is
+    transferred, bulk re-certified, and only invalidated leaves are
+    re-subdivided -- same result contract, fraction of the solves."""
     if oracle is None:
         oracle = make_oracle(problem, cfg)
+    if getattr(cfg, "rebuild_from", None):
+        from explicit_hybrid_mpc_tpu.partition.rebuild import warm_rebuild
+
+        return warm_rebuild(
+            problem, cfg, cfg.rebuild_from, oracle=oracle, obs=obs,
+            log=RunLog(cfg.log_path, echo=False),
+            strict_provenance=getattr(cfg, "rebuild_strict_provenance",
+                                      False))
     log = RunLog(cfg.log_path, echo=False)
     return FrontierEngine(problem, oracle, cfg, log, obs=obs).run()

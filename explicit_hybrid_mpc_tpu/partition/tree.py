@@ -122,6 +122,21 @@ class Tree:
     def __init__(self, p: int, n_u: int, split_hyperplanes: bool = True):
         self.p = p
         self.n_u = n_u
+        # Build provenance stamp (partition/provenance.py): set by the
+        # frontier engine at build start, carried through every pickle/
+        # checkpoint so loaders and the warm-rebuild engine can tell
+        # WHAT problem/config produced this tree.  None on trees built
+        # outside the engine (synthetic, tests) and on legacy pickles.
+        self.provenance: Optional[dict] = None
+        # Farkas exclusion event log: (node, delta) pairs where the
+        # build certified a commutation INFEASIBLE on the node's whole
+        # simplex (frontier stage-2 / infeasible-candidate passes).
+        # The warm rebuild re-verifies exactly these certificates
+        # against the revised oracle and inherits the survivors down
+        # the tree -- re-DISCOVERING them would cost a joint QP per
+        # (leaf, pending commutation), the dominant sweep cost on
+        # hybrid problems (partition/rebuild.py).  ~8 bytes/event.
+        self.excl_events: list = []
         self._n = 0
         # Split-time descent hyperplanes: each split() computes its
         # split-face normal/offset inline (one (p-1, p) nullspace solve,
@@ -350,6 +365,18 @@ class Tree:
             self._pl_zidx[s] = self._z_n
             self._z_n += 1
 
+    def clear_leaf(self, node: int) -> None:
+        """Drop a leaf's payload and flags (warm-rebuild invalidation:
+        the node re-enters the frontier as an OPEN simplex).  The
+        abandoned payload slot stays in the ragged store -- re-opened
+        leaves are a small minority of a rebuild, and slot compaction
+        would re-index every other leaf for nothing."""
+        assert self._children[node, 0] == NO_CHILD
+        if self._leaf_flags[node] & _F_DATA:
+            self._n_regions -= 1
+        self._leaf_flags[node] = 0
+        self._leaf_slot[node] = -1
+
     def leaf_payloads(self, ids: np.ndarray
                       ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
         """(delta (L,), vertex_inputs (L, p+1, n_u), vertex_costs
@@ -365,6 +392,14 @@ class Tree:
         return (self._pl_delta[slots],
                 self._pl_inputs[slots],
                 self._pl_costs[slots])
+
+    def certified_flags(self, ids: np.ndarray) -> np.ndarray:
+        """(L,) bool: eps-certified flag per node id, from the flags
+        column (the warm-rebuild sweep classifies the whole leaf set
+        this way; a per-leaf LeafData loop would be O(L) python
+        objects)."""
+        ids = np.asarray(ids, dtype=np.int64)
+        return (self._leaf_flags[ids] & _F_CERTIFIED) != 0
 
     def semi_explicit_flags(self, ids: np.ndarray) -> np.ndarray:
         """(L,) bool: semi-explicit boundary flag per node id, from the
@@ -462,6 +497,10 @@ class Tree:
                         else self._z_store[:self._z_n]),
             "n_regions": self._n_regions,
             "max_depth": self._max_depth,
+            "provenance": self.provenance,
+            "excl_events": (np.asarray(self.excl_events,
+                                       dtype=np.float64)
+                            if self.excl_events else None),
         }
 
     def __setstate__(self, state: dict) -> None:
@@ -499,6 +538,18 @@ class Tree:
             self._z_n = zs.shape[0]
         self._n_regions = state["n_regions"]
         self._max_depth = state["max_depth"]
+        # Pre-stamp columnar pickles lack the key: legacy = None.
+        self.provenance = state.get("provenance")
+        ev = state.get("excl_events")
+        if ev is None:
+            self.excl_events = []
+        elif ev.shape[1] == 2:
+            # Transitional (node, delta) int layout: exclusion-only.
+            self.excl_events = [(int(a), int(d), np.inf)
+                                for a, d in ev]
+        else:
+            self.excl_events = [(int(a), int(d), float(v))
+                                for a, d, v in ev]
         self._rederive_vertices(state["root_vertices"])
 
     def _rederive_vertices(self, root_vertices: np.ndarray) -> None:
@@ -537,6 +588,8 @@ class Tree:
             raise ValueError(
                 f"unsupported Tree pickle format {state['format']!r}")
         self.p, self.n_u = state["p"], state["n_u"]
+        self.provenance = None  # pre-stamp layout: legacy
+        self.excl_events = []
         # Pre-column pickles carry no split hyperplanes; export falls
         # back to the batched post-hoc SVD pass.
         self._split_normals_live = False

@@ -218,18 +218,26 @@ class ControllerRegistry:
     def load_artifacts(self, name: str, version: str, dir_path: str,
                        n_shards: Optional[int] = None,
                        router=None, max_bucket: Optional[int] = None,
-                       granularity: int = 8) -> ControllerVersion:
+                       granularity: int = 8,
+                       expect_provenance: Optional[dict] = None,
+                       strict: bool = False) -> ControllerVersion:
         """Build a ShardedDescent server from an exported artifact
         directory (save_artifacts layout: leaf-table ``<field>.npy``
         files + ``descent.npz``) and publish it.  Loading happens
         OUTSIDE the registry lock -- a multi-GB memmap'd table must not
         stall live lease traffic -- so two racing loads of the same
-        name resolve by publish order."""
+        name resolve by publish order.
+
+        ``expect_provenance``/``strict``: deploy-time stamp check
+        (partition/provenance.py) -- a serving deploy against a tree
+        built for a different problem/eps warns by default and raises
+        under strict, BEFORE the version reaches traffic."""
         from explicit_hybrid_mpc_tpu.online import descent as descent_mod
         from explicit_hybrid_mpc_tpu.online import export as export_mod
         from explicit_hybrid_mpc_tpu.online import sharded as sharded_mod
 
-        table = export_mod.load_leaf_table(dir_path)
+        table = export_mod.load_leaf_table(
+            dir_path, expect_provenance=expect_provenance, strict=strict)
         dt = descent_mod.load_descent(
             os.path.join(dir_path, "descent.npz"))
         server = sharded_mod.shard_descent(
@@ -239,15 +247,20 @@ class ControllerRegistry:
         return self.publish(name, version, server)
 
 
-def save_artifacts(tree, roots, dir_path: str) -> None:
+def save_artifacts(tree, roots, dir_path: str,
+                   provenance: Optional[dict] = None) -> None:
     """Export a built tree as one serving artifact directory: the
     memmap-streamed leaf table (online/export.write_leaf_table) plus
     the descent arrays as ``descent.npz`` -- exactly what
-    ControllerRegistry.load_artifacts consumes.  RSS stays O(chunk)."""
+    ControllerRegistry.load_artifacts consumes.  RSS stays O(chunk).
+    The build-provenance stamp (default: the tree's own) rides the
+    table's meta.json so a later deploy or warm rebuild can detect a
+    problem/artifact mismatch."""
     from explicit_hybrid_mpc_tpu.online import descent as descent_mod
     from explicit_hybrid_mpc_tpu.online import export as export_mod
 
-    table = export_mod.write_leaf_table(tree, dir_path)
+    table = export_mod.write_leaf_table(tree, dir_path,
+                                        provenance=provenance)
     dt = descent_mod.export_descent(tree, roots, table, stage=False)
     descent_mod.save_descent(dt, os.path.join(dir_path, "descent.npz"))
 

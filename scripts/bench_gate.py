@@ -88,12 +88,26 @@ GATED_METRICS: dict[str, tuple] = {
     # the trailing window never mixes tiers); the absolute slack
     # absorbs host-timing jitter on near-idle tiles.
     "ipm_kernel_tile_us": ("lower", 0.25, 50.0),
+    # Incremental warm rebuild (partition/rebuild.py; bench.py
+    # --rebuild rows): the fraction of prior leaves whose certificates
+    # transferred, and the wall-clock advantage over an equal-eps cold
+    # build.  Both higher-is-better; the speedup gets a wide band (it
+    # divides two noisy walls on the 2-core CI host) plus absolute
+    # slack, reuse_frac a small absolute slack so an epsilon-perturb
+    # capture with near-total reuse doesn't gate on noise.  Rebuild
+    # rows carry neither "value" nor serve_* keys, so the trailing
+    # windows never mix metric families.
+    "rebuild_reuse_frac": ("higher", 0.15, 0.05),
+    "rebuild_speedup": ("higher", 0.30, 0.25),
 }
 
 _ROW_EXTRAS = ("regions", "unit", "precision", "truncated",
                "device_failures", "uncertified",
                "serve_qps", "serve_batch_fill", "swap_dropped",
-               "swap_torn", "ipm_kernel")
+               "swap_torn", "ipm_kernel",
+               "recert_solves", "subdivision_solves",
+               "rebuild_invalidated", "rebuild_cold_wall_s",
+               "rebuild_wall_s")
 
 
 def summarize(bench: dict, source: str, mtime: float | None = None) -> dict:

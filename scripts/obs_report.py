@@ -135,6 +135,17 @@ def report(records: list[dict]) -> dict:
                 pipe["device_busy_frac"] = dfm
                 pipe["host_busy_frac"] = max(0.0, 1.0 - dfm)
             out["pipeline"] = pipe
+        # Warm-rebuild reuse economy (partition/rebuild.py): counters +
+        # the reuse_frac gauge, rendered and diff-flagged like the
+        # pipeline gauges.
+        reb = {c: out["counters"][f"rebuild.{c}"]
+               for c in ("leaves_recertified", "leaves_reused",
+                         "leaves_invalidated", "recert_solves")
+               if f"rebuild.{c}" in out["counters"]}
+        if "rebuild.reuse_frac" in out["gauges"]:
+            reb["reuse_frac"] = out["gauges"]["rebuild.reuse_frac"]
+        if reb:
+            out["rebuild"] = reb
         shards = {}
         for k, v in out["histograms"].items():
             if k.startswith(_SHARD_PREFIX) and k.endswith(".query_s"):
@@ -259,6 +270,15 @@ def diff_bench(rep: dict, bench: dict, tol: float = 0.10) -> list[str]:
             flags.append(
                 f"{label} regression: {rval:.3f} vs bench {bval_f:.3f} "
                 f"({100 * (1 - rval / bval_f):.0f}% lower)")
+    # Rebuild-economy regression (ISSUE 10): a warm rebuild reusing a
+    # smaller fraction of the prior tree than the bench's capture is
+    # re-subdividing space the revision did not actually invalidate.
+    b_reuse = bench.get("rebuild_reuse_frac")
+    r_reuse = rep.get("rebuild", {}).get("reuse_frac")
+    if b_reuse and r_reuse is not None and r_reuse < (1 - tol) * b_reuse:
+        flags.append(
+            f"rebuild reuse regression: {r_reuse:.3f} vs bench "
+            f"{b_reuse:.3f} ({100 * (1 - r_reuse / b_reuse):.0f}% lower)")
     b_waste = bench.get("spec_waste_frac")
     r_waste = pipe.get("spec_waste_frac")
     if r_waste is not None and b_waste is not None \
@@ -353,6 +373,13 @@ def render_text(rep: dict, flags: list[str], bench_path: str | None) -> str:
             f", spec hit rate {pipe.get('spec_hit_rate', 0.0):.2f}"
             f", spec waste {pipe.get('spec_waste_frac', 0.0):.3f}"
             f", dedup saved {int(pipe.get('dedup_saved', 0))}" + occ)
+    reb = rep.get("rebuild")
+    if reb:
+        ln.append(
+            f"rebuild: reused {int(reb.get('leaves_reused', 0))}/"
+            f"{int(reb.get('leaves_reused', 0)) + int(reb.get('leaves_invalidated', 0))}"
+            f" prior leaves (reuse_frac {reb.get('reuse_frac', 0.0):.3f}"
+            f", {int(reb.get('recert_solves', 0))} recert solves)")
     srv = rep.get("serve")
     if srv:
         ln.append(f"serve: {srv.get('queries')} queries "

@@ -30,6 +30,13 @@ Bundle kinds and their replay/compare contract:
   carry (cache donors are gone by capture time), so knife-edge
   convergence flips are possible: mismatches are reported, and gate
   the exit status only under ``--strict-cell``.
+- ``recert``: a warm-rebuild leaf whose stored certificate FAILED
+  re-certification (partition/rebuild.py).  Re-solves the cell's
+  vertices and re-runs the stored-delta keep-check over the snapshot
+  (plus the captured stage-2 bounds): the invalidation verdict must
+  reproduce (a 'certified' replay of an invalidated leaf is the
+  mismatch).  Vertex conv flips are advisory like ``cell`` bundles
+  (``--strict-cell`` gates them).
 
 ``--kernel-only`` (pairs bundles): bypass the Oracle pipeline and run
 the bare fixed-iteration kernel (ipm.solve_mask) on the realized
@@ -222,6 +229,46 @@ def replay_bundle(path: str, backend: str | None = None,
         # (see module docstring); --strict-cell upgrades it.
         rep["ok"] = True
         rep["cell_conv_reproduced"] = rep["conv_match"]
+    elif kind == "recert":
+        # Warm-rebuild invalidation repro: re-solve the cell's
+        # vertices, then re-run the STORED-delta keep-check over the
+        # captured snapshot + stage-2 bounds (the exact verdict inputs
+        # the sweep consumed, so this half is pure host numpy and must
+        # reproduce the invalidation deterministically).
+        sol = oracle.solve_vertices(arrays["cell_verts"])
+        rep["n_vertices"] = int(arrays["cell_verts"].shape[0])
+        rep.update(_mask_report("conv", sol.conv, arrays["obs_conv"]))
+        from explicit_hybrid_mpc_tpu.partition import certify
+
+        m, nd = arrays["obs_V"].shape
+        sd = certify.SimplexVertexData(
+            verts=arrays["cell_verts"], V=arrays["obs_V"],
+            conv=arrays["obs_conv"], grad=arrays["obs_grad"],
+            u0=np.zeros((m, nd, can.n_u)),
+            z=np.zeros((m, nd, can.nz)),
+            Vstar=arrays["obs_Vstar"], dstar=arrays["obs_dstar"])
+        d = int(meta.get("delta_idx", -1))
+        res = certify.recertify_stored_stage1(
+            sd, d, meta.get("eps_a", 0.0), meta.get("eps_r", 0.0))
+        if res.status == "pending":
+            vmin = arrays.get("recert_vmin")
+            vm = ({int(dp): float(vmin[dp])
+                   for dp in np.where(~np.isnan(vmin))[0]}
+                  if vmin is not None else {})
+            if all(int(dp) in vm for dp in res.pending_deltas):
+                res = certify.certify_suboptimal_stage2(
+                    sd, res, vm, meta.get("eps_a", 0.0),
+                    meta.get("eps_r", 0.0))
+            else:
+                rep["note"] = ("bundle carries no stage-2 bounds for "
+                               "every pending delta; stage-1 verdict "
+                               "reported")
+        rep["snapshot_verdict"] = res.status
+        rep["captured_gap"] = meta.get("gap")
+        # The bundle exists BECAUSE the sweep invalidated this leaf: a
+        # replay that certifies it contradicts the capture.
+        rep["ok"] = res.status != "certified"
+        rep["cell_conv_reproduced"] = rep["conv_match"]
     else:
         raise SystemExit(f"unknown bundle kind {kind!r} in {path}")
     return rep
@@ -296,7 +343,7 @@ def main(argv: list[str] | None = None) -> int:
     rep = replay_bundle(args.bundle, backend=args.backend,
                         kernel_only=args.kernel_only,
                         kernel_tier=args.kernel_tier)
-    if args.strict_cell and rep.get("kind") == "cell":
+    if args.strict_cell and rep.get("kind") in ("cell", "recert"):
         rep["ok"] = bool(rep["ok"] and rep.get("cell_conv_reproduced"))
     for k in sorted(rep):
         if not k.startswith("_"):

@@ -1,0 +1,419 @@
+"""Incremental warm rebuild (partition/rebuild.py) + provenance stamps.
+
+Contract tests for ISSUE 10: an unchanged problem rebuilds
+node-for-node bit-identical with ZERO subdivision solves (the
+re-certification sweep is the only oracle traffic); an eps-tightened
+rebuild reaches the cold build's certification verdicts; stale or
+unstamped priors are rejected where the caller asked for strictness;
+mid-rebuild checkpoints resume through the existing path; and the
+reuse counters land in the obs schema + health rules.
+"""
+
+import dataclasses
+import glob
+import importlib.util
+import os
+import pickle
+import sys
+
+import numpy as np
+import pytest
+
+from explicit_hybrid_mpc_tpu.config import PartitionConfig
+from explicit_hybrid_mpc_tpu.obs import Obs
+from explicit_hybrid_mpc_tpu.obs.health import HealthMonitor
+from explicit_hybrid_mpc_tpu.partition import provenance as prov
+from explicit_hybrid_mpc_tpu.partition.frontier import (FrontierEngine,
+                                                        build_partition,
+                                                        make_oracle)
+from explicit_hybrid_mpc_tpu.partition.rebuild import (RebuildError,
+                                                       publish_rebuild,
+                                                       warm_rebuild)
+from explicit_hybrid_mpc_tpu.problems.registry import make
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _tree_states_equal(ta, tb, ignore=("provenance",)) -> bool:
+    a, b = ta.__getstate__(), tb.__getstate__()
+    if set(a) != set(b):
+        return False
+    for k in a:
+        if k in ignore:
+            continue
+        va, vb = a[k], b[k]
+        if isinstance(va, np.ndarray) or isinstance(vb, np.ndarray):
+            if not np.array_equal(va, vb):
+                return False
+        elif va != vb:
+            return False
+    return True
+
+
+@pytest.fixture(scope="module")
+def di_problem():
+    return make("double_integrator", N=3, theta_box=1.5)
+
+
+@pytest.fixture(scope="module")
+def di_cfg():
+    return PartitionConfig(problem="double_integrator", eps_a=0.3,
+                           backend="cpu", batch_simplices=128)
+
+
+@pytest.fixture(scope="module")
+def prior(di_problem, di_cfg):
+    """The prior build every rebuild test transfers from."""
+    return build_partition(di_problem, di_cfg)
+
+
+@pytest.fixture(scope="module")
+def tight_cfg(di_cfg):
+    return dataclasses.replace(di_cfg, eps_a=0.15)
+
+
+@pytest.fixture(scope="module")
+def tight_rebuild(di_problem, tight_cfg, prior):
+    return warm_rebuild(di_problem, tight_cfg, prior.tree)
+
+
+# -- the acceptance case ---------------------------------------------------
+
+
+def test_unchanged_rebuild_bit_identical_zero_subdivision(
+        di_problem, di_cfg, prior, tmp_path):
+    path = str(tmp_path / "prior.tree.pkl")
+    prior.tree.save(path)
+    res = warm_rebuild(di_problem, di_cfg, path)
+    st = res.stats
+    assert st["subdivision_solves"] == 0
+    assert st["rebuild_leaves_invalidated"] == 0
+    assert st["rebuild_reuse_frac"] == 1.0
+    assert st["recert_solves"] > 0  # the sweep DID re-prove everything
+    assert st["regions"] == prior.stats["regions"]
+    assert _tree_states_equal(prior.tree, res.tree)
+    # The new tree is re-stamped with the (identical) revision's stamp.
+    assert res.tree.provenance is not None
+    assert prov.diff_stamps(res.tree.provenance,
+                            prior.tree.provenance) == []
+
+
+def test_unchanged_rebuild_via_build_partition_route(di_problem, di_cfg,
+                                                     prior, tmp_path):
+    path = str(tmp_path / "prior.tree.pkl")
+    prior.tree.save(path)
+    cfg = dataclasses.replace(di_cfg, rebuild_from=path)
+    res = build_partition(di_problem, cfg)
+    assert res.stats["rebuild_reuse_frac"] == 1.0
+    assert _tree_states_equal(prior.tree, res.tree)
+
+
+def test_eps_tightened_rebuild_matches_cold_verdicts(
+        di_problem, tight_cfg, prior, tight_rebuild):
+    cold = build_partition(di_problem, tight_cfg)
+    st = tight_rebuild.stats
+    # Equal certification: both fully eps-certified, no truncation.
+    assert st["uncertified"] == 0 and cold.stats["uncertified"] == 0
+    assert not st["truncated"] and not cold.stats["truncated"]
+    assert 0.0 < st["rebuild_reuse_frac"] < 1.0
+    assert st["rebuild_leaves_invalidated"] > 0
+    assert st["subdivision_solves"] > 0
+    assert st["provenance_changed"] == ["eps_a: 0.3 != 0.15"]
+    # Certification-verdict parity on a theta sweep: every sampled
+    # point lands in a leaf of the same kind (certified payload /
+    # infeasible hole) in the cold and rebuilt trees.
+    rng = np.random.default_rng(0)
+    qs = rng.uniform(di_problem.theta_lb, di_problem.theta_ub,
+                     size=(400, di_problem.n_theta))
+    for q in qs:
+        la = cold.tree.locate(q, cold.roots)
+        lb = tight_rebuild.tree.locate(q, tight_rebuild.roots)
+        da = cold.tree.leaf_data[la] if la >= 0 else None
+        db = tight_rebuild.tree.leaf_data[lb] if lb >= 0 else None
+        assert (da is None) == (db is None)
+        if da is not None:
+            assert da.certified == db.certified
+
+
+def test_rebuild_checkpoint_donors_are_consumed(di_problem, di_cfg,
+                                                tmp_path):
+    """A CHECKPOINT prior donates its VertexCache duals as warm starts
+    (the sweep's pair path); the produced tree still matches."""
+    ckpt = str(tmp_path / "prior.ckpt.pkl")
+    cfg = dataclasses.replace(di_cfg, checkpoint_every=3,
+                              checkpoint_path=ckpt, max_steps=6)
+    res = build_partition(di_problem, cfg)  # truncated, ckpt written
+    assert os.path.exists(ckpt)
+    full_cfg = dataclasses.replace(cfg, checkpoint_every=0,
+                                   checkpoint_path=None, max_steps=10_000)
+    reb = warm_rebuild(di_problem, full_cfg, ckpt)
+    # The mid-build checkpoint's open frontier nodes carry no
+    # certificates: the sweep re-opens them (any feasible vertex fails
+    # the emptiness re-check) and the frontier completes the build.
+    # Its VertexCache rows, though, were offered as warm-start donors.
+    assert reb.stats["uncertified"] == 0
+    assert not reb.stats["truncated"]
+    assert reb.stats["rebuild_leaves_total"] > 0
+    assert reb.stats["warm_donor_vertices"] > 0
+    assert reb.stats["regions"] > 0
+
+
+def test_resume_mid_rebuild_reaches_the_same_tree(
+        di_problem, tight_cfg, prior, tight_rebuild, tmp_path):
+    ckpt = str(tmp_path / "rebuild.ckpt.pkl")
+    cfg = dataclasses.replace(tight_cfg, checkpoint_every=1,
+                              checkpoint_path=ckpt, max_steps=1)
+    partial = warm_rebuild(di_problem, cfg, prior.tree)
+    assert partial.stats["truncated"]
+    assert os.path.exists(ckpt)
+    oracle = make_oracle(di_problem, tight_cfg)
+    eng = FrontierEngine.resume(ckpt, di_problem, oracle,
+                                cfg=dataclasses.replace(
+                                    tight_cfg, max_steps=10_000))
+    res = eng.run()
+    assert res.stats["uncertified"] == 0
+    assert _tree_states_equal(res.tree, tight_rebuild.tree)
+
+
+# -- rejection / provenance ------------------------------------------------
+
+
+def test_incompatible_prior_rejected(prior):
+    other = make("double_integrator", N=3, theta_box=2.0)
+    cfg = PartitionConfig(problem="double_integrator", eps_a=0.3,
+                          backend="cpu")
+    with pytest.raises(RebuildError, match="root triangulation"):
+        warm_rebuild(other, cfg, prior.tree)
+
+
+def test_strict_provenance_rejects_unstamped_prior(di_problem, di_cfg,
+                                                   prior):
+    legacy = pickle.loads(pickle.dumps(prior.tree))
+    legacy.provenance = None
+    with pytest.raises(prov.ProvenanceMismatch, match="no provenance"):
+        warm_rebuild(di_problem, di_cfg, legacy,
+                     strict_provenance=True)
+    # Default shims: the rebuild proceeds and records the shim.
+    res = warm_rebuild(di_problem, di_cfg, legacy)
+    assert any("no provenance" in d
+               for d in res.stats["provenance_changed"])
+    assert res.stats["rebuild_reuse_frac"] == 1.0
+
+
+def test_artifact_loaders_check_stamps(prior, tmp_path):
+    from explicit_hybrid_mpc_tpu.online import export
+    from explicit_hybrid_mpc_tpu.serve.registry import save_artifacts
+
+    d = str(tmp_path / "art")
+    save_artifacts(prior.tree, prior.roots, d)
+    stamp = export.load_table_provenance(d)
+    assert stamp is not None
+    assert stamp["problem_hash"] == \
+        prior.tree.provenance["problem_hash"]
+    # Matching expectation: silent.
+    export.load_leaf_table(d, expect_provenance=prior.tree.provenance)
+    stale = dict(prior.tree.provenance, eps_a=99.0,
+                 problem_hash="deadbeefdeadbeef")
+    with pytest.warns(prov.ProvenanceWarning, match="mismatch"):
+        export.load_leaf_table(d, expect_provenance=stale)
+    with pytest.raises(prov.ProvenanceMismatch):
+        export.load_leaf_table(d, expect_provenance=stale, strict=True)
+
+
+def test_legacy_stampless_table_shims(prior, tmp_path):
+    from explicit_hybrid_mpc_tpu.online import export
+
+    d = str(tmp_path / "legacy")
+    table = export.export_leaves(prior.tree)
+    export.save_leaf_table(table, d)  # no provenance passed
+    assert export.load_table_provenance(d) is None
+    # Expectation against an unstamped table: warns, loads, NEVER
+    # raises even under strict (nothing to compare).
+    with pytest.warns(prov.ProvenanceWarning, match="no provenance"):
+        t2 = export.load_leaf_table(
+            d, expect_provenance=prior.tree.provenance, strict=True)
+    assert t2.n_leaves == table.n_leaves
+
+
+def test_checkpoint_carries_stamp(di_problem, di_cfg, tmp_path):
+    ckpt = str(tmp_path / "c.pkl")
+    cfg = dataclasses.replace(di_cfg, checkpoint_every=2,
+                              checkpoint_path=ckpt, max_steps=4)
+    build_partition(di_problem, cfg)
+    with open(ckpt, "rb") as f:
+        snap = pickle.load(f)
+    assert snap["provenance"]["problem_hash"] == \
+        prov.problem_hash(di_problem)
+    assert snap["tree"].provenance is not None
+
+
+# -- publish path ----------------------------------------------------------
+
+
+def test_publish_rebuild_hot_swaps_registry(di_problem, di_cfg, prior,
+                                            tmp_path):
+    from explicit_hybrid_mpc_tpu.serve.registry import ControllerRegistry
+
+    reg = ControllerRegistry()
+    d1 = str(tmp_path / "v1")
+    v1 = publish_rebuild(prior, d1, registry=reg, name="di")
+    assert reg.active_version("di") == v1
+    res = warm_rebuild(di_problem, dataclasses.replace(di_cfg, eps_a=0.25),
+                       prior.tree)
+    d2 = str(tmp_path / "v2")
+    v2 = publish_rebuild(res, d2, registry=reg, name="di")
+    assert v2 != v1
+    assert reg.active_version("di") == v2
+    with reg.lease("di") as ver:
+        assert ver.version == v2
+
+
+# -- obs / health / gate wiring --------------------------------------------
+
+
+def test_rebuild_obs_counters_land_in_schema(di_problem, tight_cfg,
+                                             prior):
+    o = Obs("jsonl")
+    res = warm_rebuild(di_problem, tight_cfg, prior.tree, obs=o)
+    snap = o.metrics.snapshot()
+    c, g = snap["counters"], snap["gauges"]
+    st = res.stats
+    assert c["rebuild.leaves_recertified"] == \
+        st["rebuild_leaves_recertified"]
+    assert c["rebuild.leaves_reused"] == st["rebuild_leaves_reused"]
+    assert c["rebuild.leaves_invalidated"] == \
+        st["rebuild_leaves_invalidated"]
+    assert c["rebuild.recert_solves"] == st["recert_solves"]
+    assert g["rebuild.reuse_frac"] == pytest.approx(
+        st["rebuild_reuse_frac"], abs=1e-4)
+    events = [r for r in o.sink.records
+              if r.get("kind") == "event"
+              and r.get("name") == "rebuild.sweep"]
+    assert len(events) == 1
+    o.close()
+
+
+def test_obs_report_renders_rebuild_block(di_problem, tight_cfg, prior,
+                                          tmp_path):
+    spec = importlib.util.spec_from_file_location(
+        "obs_report", os.path.join(REPO, "scripts", "obs_report.py"))
+    obs_report = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(obs_report)
+    path = str(tmp_path / "r.obs.jsonl")
+    o = Obs("jsonl", path=path)
+    warm_rebuild(di_problem, tight_cfg, prior.tree, obs=o)
+    o.close()
+    from explicit_hybrid_mpc_tpu.obs.sink import load_jsonl
+
+    rep = obs_report.report(load_jsonl(path))
+    assert "rebuild" in rep
+    assert rep["rebuild"]["reuse_frac"] > 0
+    txt = obs_report.render_text(rep, [], None)
+    assert "rebuild:" in txt
+    # diff_bench flags a reuse collapse vs a bench row.
+    flags = obs_report.diff_bench(
+        rep, {"rebuild_reuse_frac": rep["rebuild"]["reuse_frac"] * 4})
+    assert any("rebuild reuse regression" in f for f in flags)
+
+
+def test_health_rebuild_reuse_collapse_rule():
+    mon = HealthMonitor({"min_rebuild_reuse": 0.5,
+                         "min_rebuild_leaves": 10})
+    rec = {"kind": "metrics",
+           "counters": {"rebuild.leaves_reused": 2,
+                        "rebuild.leaves_invalidated": 98},
+           "gauges": {"rebuild.reuse_frac": 0.02}}
+    evs = mon.feed(rec)
+    assert any(e["name"] == "health.rebuild_reuse_collapse"
+               for e in evs)
+    assert mon.worst == "warn"
+    # Volume gate (its OWN leaf-count floor, not the solve-count
+    # knob): a tiny rebuild never fires.
+    mon2 = HealthMonitor({"min_rebuild_reuse": 0.5,
+                          "min_rebuild_leaves": 1000})
+    assert mon2.feed(rec) == []
+    # 0 disables.
+    mon3 = HealthMonitor({"min_rebuild_reuse": 0.0,
+                          "min_rebuild_leaves": 10})
+    assert mon3.feed(rec) == []
+
+
+def test_bench_gate_gates_rebuild_metrics():
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    try:
+        import bench_gate
+    finally:
+        sys.path.pop(0)
+    assert bench_gate.GATED_METRICS["rebuild_reuse_frac"][0] == "higher"
+    assert bench_gate.GATED_METRICS["rebuild_speedup"][0] == "higher"
+    row = bench_gate.summarize(
+        {"platform": "cpu", "metric": "warm-rebuild",
+         "rebuild_reuse_frac": 0.8, "rebuild_speedup": 2.0,
+         "recert_solves": 123}, "BENCH_rebuild_r01.json", mtime=1.0)
+    assert row["rebuild_reuse_frac"] == 0.8
+    assert row["recert_solves"] == 123
+    hist = [{"platform": "cpu", "source": "old.json",
+             "rebuild_reuse_frac": 0.9, "rebuild_speedup": 2.0}]
+    flags, _info = bench_gate.gate(
+        dict(row, rebuild_reuse_frac=0.2, rebuild_speedup=0.5), hist)
+    assert any("rebuild_reuse_frac" in f for f in flags)
+    assert any("rebuild_speedup" in f for f in flags)
+
+
+def test_dead_ledger_events_pruned_from_rebuilt_tree(di_problem, di_cfg,
+                                                     prior):
+    """A stale exclusion event that fails re-verification must NOT ride
+    into the rebuilt tree's ledger (it would be re-checked -- and fail
+    -- on every future chained rebuild)."""
+    doctored = pickle.loads(pickle.dumps(prior.tree))
+    root = doctored.roots()[0]
+    # The root simplex is feasible for delta 0, so this bogus emptiness
+    # certificate cannot re-verify.
+    doctored.excl_events.append((int(root), 0, np.inf))
+    res = warm_rebuild(di_problem, di_cfg, doctored)
+    assert (int(root), 0, np.inf) not in [
+        (a, d, v) for a, d, v in res.tree.excl_events]
+    # The doctored event changed nothing else: full reuse still holds.
+    assert res.stats["rebuild_reuse_frac"] == 1.0
+    assert res.stats["rebuild_excl_events"] == \
+        len(set((a, d) for a, d, _v in doctored.excl_events))
+
+
+# -- recorder / replay -----------------------------------------------------
+
+
+def test_invalidated_leaf_recert_bundle_replays(di_problem, tight_cfg,
+                                                prior, tmp_path):
+    rec_dir = str(tmp_path / "repro")
+    cfg = dataclasses.replace(tight_cfg, obs_recorder=True,
+                              recorder_dir=rec_dir)
+    warm_rebuild(di_problem, cfg, prior.tree)
+    bundles = sorted(glob.glob(
+        os.path.join(rec_dir, "*recert_invalidated*.npz")))
+    assert bundles, "eps-tightened rebuild must dump recert bundles"
+    spec = importlib.util.spec_from_file_location(
+        "replay_solve", os.path.join(REPO, "scripts", "replay_solve.py"))
+    replay_solve = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(replay_solve)
+    rep = replay_solve.replay_bundle(bundles[0])
+    assert rep["kind"] == "recert"
+    assert rep["snapshot_verdict"] != "certified"
+    assert rep["ok"]
+
+
+# -- CLI surface -----------------------------------------------------------
+
+
+def test_rebuild_subcommand_requires_from():
+    from explicit_hybrid_mpc_tpu.main import main
+
+    with pytest.raises(SystemExit, match="--from"):
+        main(["rebuild", "-e", "double_integrator"])
+
+
+def test_rebuild_and_resume_exclusive():
+    from explicit_hybrid_mpc_tpu.main import main
+
+    with pytest.raises(SystemExit, match="exclusive"):
+        main(["-e", "double_integrator", "--rebuild-from", "x.pkl",
+              "--resume", "y.pkl"])

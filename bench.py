@@ -681,6 +681,9 @@ def run(result: dict, monitor: ContentionMonitor | None = None) -> None:
                   # flaky tunnel makes a 'tpu' number partially CPU-run;
                   # nonzero here flags that honestly).
                   device_failures=stats["device_failures"],
+                  # Poison cells given up on after bounded recovery
+                  # (faults/policy.py); 0 on any healthy capture.
+                  quarantined_cells=stats.get("quarantined_cells", 0),
                   # Adaptive-work figures (two-phase cohort + tree
                   # warm-starts): actual f64 IPM iterations vs what the
                   # fixed single-phase schedule would have issued for
@@ -951,6 +954,7 @@ def run_rebuild(result: dict, monitor=None) -> None:
         truncated=(st["truncated"] or res_b.stats["truncated"]
                    or res_a.stats["truncated"]),
         device_failures=st["device_failures"],
+        quarantined_cells=st.get("quarantined_cells", 0),
         warm_start_tree=getattr(oracle, "warm_start", False),
         ipm_kernel=getattr(oracle, "ipm_kernel", "xla"))
     log(f"rebuild: reuse {st['rebuild_reuse_frac']:.3f}, "

@@ -274,6 +274,35 @@ class PartitionConfig:
     # (partial batches) are exempt: small final batches legitimately
     # mint new pow-2 buckets.
     recompile_guard: str = "off"
+    # Bounded-recovery policy around oracle solves (faults/policy.py
+    # RetryPolicy; docs/robustness.md).  solve_timeout_s arms a
+    # watchdog around EVERY oracle attempt -- a wedged solve raises
+    # SolveTimeout and takes the device-failure recovery path instead
+    # of hanging the build (None = off: the watchdog costs one thread
+    # hop per synchronous oracle call).
+    solve_timeout_s: Optional[float] = None
+    # CPU-twin retry attempts (with exponential backoff starting at
+    # oracle_retry_backoff_s) after a device failure before the batch's
+    # cells are QUARANTINED: synthesized conservative no-information
+    # results (+inf/unconverged points, -inf no-bound simplex rows) let
+    # the build continue soundly -- affected cells split or close
+    # uncertified, never certify wrong.  Quarantined counts surface in
+    # stats['quarantined_cells'] / the build.quarantined_cells counter
+    # and are gated by the max_quarantine_frac health rule.
+    oracle_retry_attempts: int = 2
+    oracle_retry_backoff_s: float = 0.05
+    # Total device failures tolerated before the engine DEGRADES to
+    # the CPU fallback oracle permanently (faults.device_degraded
+    # event): a dead accelerator costs the dispatch-fail-fallback tax
+    # once, not on every remaining batch of a multi-hour campaign.
+    # (Not a padding bucket -- a failure COUNT; pow-2 is meaningless.)
+    device_failure_cap: int = 3  # tpulint: disable=recompile-hazard -- failure count, not a shape
+    # Deterministic fault-injection plan (faults/plan.py FaultPlan, a
+    # dict, or a path to a plan JSON; the EHM_FAULT_PLAN env var is the
+    # subprocess surface).  None = no injection (the production
+    # default: every hook is one global None-test).  Chaos testing
+    # only -- scripts/chaos_suite.py is the pre-merge consumer.
+    fault_plan: Optional[object] = None
     seed: int = 0
 
     def __post_init__(self) -> None:
@@ -308,6 +337,15 @@ class PartitionConfig:
             raise ValueError(f"unknown recompile_guard "
                              f"{self.recompile_guard!r} (expected 'off', "
                              "'warn', or 'raise')")
+        if self.solve_timeout_s is not None and self.solve_timeout_s <= 0:
+            raise ValueError("solve_timeout_s must be > 0 (or None "
+                             "to disable the solve watchdog)")
+        if self.oracle_retry_attempts < 1:
+            raise ValueError("oracle_retry_attempts must be >= 1")
+        if self.oracle_retry_backoff_s < 0:
+            raise ValueError("oracle_retry_backoff_s must be >= 0")
+        if self.device_failure_cap < 1:
+            raise ValueError("device_failure_cap must be >= 1")
         if self.health_rules:
             # Validate rule names eagerly: a typo'd rule that silently
             # never fires defeats the watchdog's purpose.

@@ -1,0 +1,172 @@
+"""Scripted fault schedules: what breaks, where, and on which call.
+
+A ``FaultPlan`` is a deterministic, seeded script of failures -- "the
+3rd oracle dispatch raises a device error", "the solve at step 40
+hangs for 2 s", "the process dies between checkpoint rotation and the
+atomic write" -- replayed by the ``FaultInjector`` (injector.py)
+through one-line hooks threaded across the build/rebuild/serve stack.
+Determinism is by construction: a spec fires on the K-th invocation of
+its SITE (per-site counters, optionally narrowed by a label match),
+never on wall clock or randomness; the plan's ``seed`` feeds only the
+corruption byte generator, so a given plan always corrupts the same
+bytes.
+
+Sites (the injection-point catalog; docs/robustness.md keeps the
+prose version):
+
+==================  ====================================================
+``oracle.call``     synchronous oracle query (frontier._oracle_call);
+                    label = method name (``solve_simplex_min``, ...)
+``oracle.dispatch`` non-blocking device dispatch (Oracle.dispatch_
+                    vertices / dispatch_pairs); label = program kind
+``oracle.wait``     blocking wait on a dispatched handle
+                    (frontier._wait_or_fallback); label = kind
+``oracle.fallback`` the CPU-twin retry attempt itself (lets a plan
+                    exhaust the retry budget and force quarantine)
+``build.step``      top of each frontier step; label = str(step)
+``checkpoint.write``  between generation rotation and the atomic
+                    checkpoint write (a crash here proves the
+                    previous-generation fallback)
+``checkpoint.written``  after the checkpoint landed (``corrupt`` kind
+                    mangles the finished file = at-rest corruption)
+``artifact.written``  after save_artifacts finished (ditto)
+``rebuild.sweep``   before the warm rebuild's bulk re-certify
+``registry.publish``  top of ControllerRegistry.publish, before any
+                    mutation (an injected swap crash must leave the
+                    registry serving the old version)
+``serve.batch``     inside the scheduler's leased batch evaluation (a
+                    worker dying mid-batch must not pin the lease)
+==================  ====================================================
+
+Kinds:
+
+- ``error``: raise ``InjectedFault`` (a RuntimeError, so the existing
+  device-failure handlers treat it exactly like a dead TPU tunnel).
+- ``hang``: sleep ``hang_s`` (default 2.0) then raise InjectedFault --
+  a solve that never returns usefully.  With ``cfg.solve_timeout_s``
+  set the timeout watchdog fires first; without it the build stalls
+  for ``hang_s`` and then recovers via the same failure path (bounded
+  either way -- a plan must never be able to hang CI forever).
+- ``crash``: kill the run at the hook.  ``process_exit=True`` plans
+  (the supervised-subprocess mode) call ``os._exit(exit_code)`` --
+  no cleanup, no atexit, the closest in-process stand-in for SIGKILL;
+  otherwise ``InjectedCrash`` (an Exception NOT derived from
+  RuntimeError/OSError, so no retry/fallback layer may swallow it)
+  propagates out of the build.
+- ``corrupt``: mangle the file at the hook's ``path`` -- truncate to
+  ``keep_frac`` (default 0.5) of its bytes, then XOR the final byte
+  with a seeded value, simulating a torn/bit-rotted artifact.  Only
+  meaningful at ``*.written`` sites.
+
+Plans load from JSON (``FaultPlan.from_json``; the ``EHM_FAULT_PLAN``
+env var and ``cfg.fault_plan`` both take a path), e.g.::
+
+    {"seed": 7, "process_exit": true,
+     "faults": [
+       {"site": "oracle.wait", "kind": "error", "at": 2},
+       {"site": "checkpoint.write", "kind": "crash", "at": 1}]}
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Optional
+
+KINDS = ("error", "hang", "crash", "corrupt")
+
+SITES = (
+    "oracle.call", "oracle.dispatch", "oracle.wait", "oracle.fallback",
+    "build.step", "checkpoint.write", "checkpoint.written",
+    "artifact.written", "rebuild.sweep", "registry.publish",
+    "serve.batch",
+)
+
+
+class InjectedFault(RuntimeError):
+    """A scripted device-style failure (RuntimeError on purpose: the
+    production handlers that catch XlaRuntimeError must handle this
+    identically -- that equivalence is what the chaos suite tests)."""
+
+
+class InjectedCrash(Exception):
+    """A scripted crash.  Deliberately NOT a RuntimeError/OSError: no
+    retry or fallback layer is allowed to absorb it -- it must unwind
+    the whole build, like the SIGKILL it stands in for."""
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultSpec:
+    """One scripted fault: fires on invocations ``at .. at+count-1``
+    of ``site`` (1-based: at=1 is the first matching call), optionally
+    only when the hook's label contains ``match``."""
+
+    site: str
+    kind: str
+    at: int = 1
+    count: int = 1
+    match: Optional[str] = None
+    # kind-specific knobs (hang_s, exit_code, keep_frac); a plain dict
+    # keeps the JSON surface flat.
+    hang_s: float = 2.0
+    exit_code: int = 43
+    keep_frac: float = 0.5
+
+    def __post_init__(self) -> None:
+        if self.site not in SITES:
+            raise ValueError(f"unknown fault site {self.site!r} "
+                             f"(known: {', '.join(SITES)})")
+        if self.kind not in KINDS:
+            raise ValueError(f"unknown fault kind {self.kind!r} "
+                             f"(known: {', '.join(KINDS)})")
+        if self.at < 1 or self.count < 1:
+            raise ValueError("FaultSpec.at and .count must be >= 1 "
+                             "(at is 1-based)")
+        if not 0.0 <= self.keep_frac < 1.0:
+            raise ValueError("keep_frac must be in [0, 1)")
+
+    def applies(self, n: int, label: Optional[str]) -> bool:
+        """Does this spec fire on the `n`-th (1-based) matching
+        invocation of its site?"""
+        if not self.at <= n < self.at + self.count:
+            return False
+        return self.match is None or (label is not None
+                                      and self.match in label)
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultPlan:
+    """An ordered script of FaultSpecs + the determinism knobs."""
+
+    faults: tuple = ()
+    seed: int = 0
+    # True: 'crash' kinds os._exit the process (supervised-subprocess
+    # chaos runs); False: they raise InjectedCrash (in-process tests).
+    process_exit: bool = False
+
+    def __post_init__(self) -> None:
+        object.__setattr__(self, "faults", tuple(
+            f if isinstance(f, FaultSpec) else FaultSpec(**f)
+            for f in self.faults))
+
+    def to_dict(self) -> dict:
+        return {"seed": self.seed, "process_exit": self.process_exit,
+                "faults": [dataclasses.asdict(f) for f in self.faults]}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "FaultPlan":
+        unknown = set(d) - {"faults", "seed", "process_exit"}
+        if unknown:
+            raise ValueError(f"unknown FaultPlan keys {sorted(unknown)}")
+        return cls(faults=tuple(d.get("faults", ())),
+                   seed=int(d.get("seed", 0)),
+                   process_exit=bool(d.get("process_exit", False)))
+
+    @classmethod
+    def from_json(cls, path: str) -> "FaultPlan":
+        with open(path) as f:
+            return cls.from_dict(json.load(f))
+
+    def save(self, path: str) -> None:
+        with open(path, "w") as f:
+            json.dump(self.to_dict(), f, indent=2)

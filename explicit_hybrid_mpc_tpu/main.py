@@ -157,6 +157,18 @@ def build_parser() -> argparse.ArgumentParser:
                         "shape on a full-size frontier step emits a "
                         "health.recompile event (warn) or aborts the "
                         "build (raise)")
+    p.add_argument("--solve-timeout", type=float, default=None,
+                   metavar="S",
+                   help="watchdog timeout per oracle attempt "
+                        "(faults/policy.py): a wedged solve raises "
+                        "SolveTimeout and takes the device-failure "
+                        "recovery path (bounded retries, then "
+                        "poison-cell quarantine) instead of hanging "
+                        "the build")
+    p.add_argument("--fault-plan", metavar="PLAN.json", default=None,
+                   help="deterministic fault-injection plan "
+                        "(faults/plan.py; chaos testing only -- "
+                        "scripts/chaos_suite.py drives this)")
     p.add_argument("--health-rule", action="append", default=[],
                    metavar="NAME=VALUE",
                    help="override a streaming health rule (repeatable; "
@@ -240,10 +252,14 @@ def main(argv: list[str] | None = None) -> int:
         # resumed cpu/serial build must still get the pin below (else a
         # dead TPU tunnel hangs a pure-CPU run).  Unpickling touches no
         # device; the dict is reused by the resume block further down.
-        import pickle
+        # load_checkpoint verifies the content checksum and falls back
+        # to the .prev generation on a torn/corrupt file -- the
+        # supervised-restart path (scripts/supervise_build.py) resumes
+        # through exactly this loader.
+        from explicit_hybrid_mpc_tpu.partition.frontier import (
+            load_checkpoint)
 
-        with open(args.resume, "rb") as f:
-            snapshot = pickle.load(f)
+        snapshot = load_checkpoint(args.resume)
 
     effective_backend = snapshot["cfg"].backend if snapshot else args.backend
     if effective_backend in ("cpu", "serial"):
@@ -307,6 +323,8 @@ def main(argv: list[str] | None = None) -> int:
                       if args.recorder or args.recorder_dir else None),
         health_rules=_parse_health_rules(args.health_rule),
         recompile_guard=args.recompile_guard or "off",
+        solve_timeout_s=args.solve_timeout,
+        fault_plan=args.fault_plan,
         rebuild_from=args.rebuild_from,
         rebuild_strict_provenance=args.strict_provenance)
 
@@ -388,7 +406,15 @@ def main(argv: list[str] | None = None) -> int:
             obs_recorder=cfg.obs_recorder,
             recorder_dir=cfg.recorder_dir,
             health_rules=cfg.health_rules,
-            recompile_guard=cfg.recompile_guard)
+            recompile_guard=cfg.recompile_guard,
+            # Recovery/chaos knobs are run-scoped like the diagnostics
+            # flags: retries, timeouts, and injection change when work
+            # runs and where it falls back, never a solved value.
+            solve_timeout_s=cfg.solve_timeout_s,
+            oracle_retry_attempts=cfg.oracle_retry_attempts,
+            oracle_retry_backoff_s=cfg.oracle_retry_backoff_s,
+            device_failure_cap=cfg.device_failure_cap,
+            fault_plan=cfg.fault_plan)
 
     # Built from the FINAL cfg: on resume that is the snapshot's problem +
     # constructor args, so matrix shapes always match the restored cache.

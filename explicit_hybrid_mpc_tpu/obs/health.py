@@ -60,6 +60,15 @@ Rule schema (all values floats; 0 disables a threshold rule):
 ``min_rebuild_leaves``     prior-leaf volume floor for the rule above
                            (a tiny prior legitimately invalidates
                            wholesale)
+``max_quarantine_frac``    quarantined cells (build.quarantined_cells,
+                           faults/policy.py poison-cell quarantine) as
+                           a fraction of all solved point+simplex
+                           cells, volume-gated on
+                           ``min_solves_for_rates`` ->
+                           ``health.quarantine`` (critical): the
+                           build is surviving by GIVING UP on cells
+                           at scale -- solver infrastructure is
+                           broken, not one poison cell
 ``min_solves_for_rates``   rate rules stay silent below this volume
 ``metrics_every_steps``    engine-side feed cadence (frontier.py)
 =========================  =============================================
@@ -96,6 +105,7 @@ DEFAULT_RULES: dict[str, float] = {
     "fallback_frac": 0.25,
     "min_rebuild_reuse": 0.2,
     "min_rebuild_leaves": 500.0,
+    "max_quarantine_frac": 0.02,
     "min_solves_for_rates": 2000.0,
     "metrics_every_steps": 100.0,
 }
@@ -364,6 +374,27 @@ class HealthMonitor:
                        "-- check the prior artifact's provenance stamp "
                        "(a drifted problem hash makes every "
                        "certificate fail)")
+
+        # Quarantine storm (faults/policy.py): poison-cell quarantine
+        # exists so ONE unrecoverable batch cannot kill a campaign --
+        # but a meaningful FRACTION of all cells being given up on
+        # means the solver infrastructure itself is broken (dead
+        # device AND broken CPU twin, systematic timeout), and the
+        # "surviving" build is quietly producing an
+        # uncertified-riddled tree.  Critical: checkpoint-and-halt
+        # beats burning the allocation.
+        lim = self.rules["max_quarantine_frac"]
+        q = counters.get("build.quarantined_cells", 0)
+        denom = q + points + counters.get("oracle.simplex_solves", 0)
+        if lim > 0 and q > 0 and denom >= min_n:
+            frac = q / denom
+            if frac > lim:
+                self._fire(
+                    "quarantine", "critical", round(frac, 4), lim,
+                    f"{q} cells quarantined ({100 * frac:.1f}% of "
+                    f"{denom} solved cells, > {100 * lim:.0f}%): "
+                    "recovery is failing at scale -- check the "
+                    "fallback oracle and the device, not the cells")
 
         lim = self.rules["max_competing_cpu_frac"]
         host = gauges.get("host.competing_cpu_frac_mean")

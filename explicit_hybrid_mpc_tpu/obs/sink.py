@@ -51,7 +51,8 @@ class JsonlSink:
                  base_t: float = 0.0, keep: bool = True,
                  max_records: int = 500_000,
                  schema_meta: bool = False,
-                 tap: Optional[Callable[[dict], None]] = None):
+                 tap: Optional[Callable[[dict], None]] = None,
+                 fsync_every: int = 0):
         """base_t: cumulative elapsed seconds from PREVIOUS sessions of
         a resumed run, so the `t` column stays monotonic across an
         append boundary (see utils.logging.RunLog).  keep=False skips
@@ -61,8 +62,15 @@ class JsonlSink:
         the memory copy stops growing (n_dropped counts the overflow).
         tap: optional callable invoked with every record dict after it
         is written (the flight recorder's ring-buffer feed,
-        obs/recorder.py); may also be assigned later via `sink.tap`."""
+        obs/recorder.py); may also be assigned later via `sink.tap`.
+        fsync_every: durable mode (utils/atomic.py) -- fsync the
+        stream file every N records (and at close), bounding how much
+        of the tail a power loss can take; 0 (default) keeps the
+        flush-only behavior (an OS crash can lose page-cache tail, a
+        process crash cannot -- every line is flushed)."""
         self._lock = threading.Lock()
+        self._fsync_every = int(fsync_every)
+        self._since_fsync = 0
         self._fh: Optional[IO[str]] = open(path, "a") if path else None
         self.path = path
         self._echo = echo
@@ -107,6 +115,13 @@ class JsonlSink:
             if self._fh:
                 self._fh.write(line + "\n")
                 self._fh.flush()
+                if self._fsync_every:
+                    self._since_fsync += 1
+                    if self._since_fsync >= self._fsync_every:
+                        self._since_fsync = 0
+                        from explicit_hybrid_mpc_tpu.utils import atomic
+
+                        atomic.fsync_fileobj(self._fh)
         if self._echo:
             print(line, file=sys.stderr)
         if self.tap is not None:
@@ -116,6 +131,13 @@ class JsonlSink:
     def close(self) -> None:
         with self._lock:
             if self._fh:
+                if self._fsync_every:
+                    from explicit_hybrid_mpc_tpu.utils import atomic
+
+                    try:
+                        atomic.fsync_fileobj(self._fh)
+                    except OSError:
+                        pass  # closing anyway; fsync is best-effort here
                 self._fh.close()
                 self._fh = None
         self._unregister_atexit()

@@ -207,15 +207,22 @@ def evaluate_descent(table: DescentTable, dev: DeviceLeafTable,
 def save_descent(table: DescentTable, path: str) -> None:
     """Persist descent arrays as one .npz: with save_leaf_table /
     load_leaf_table (online.export) the deployed online stage loads
-    flat arrays only -- never the multi-GB pickled Tree."""
-    np.savez(path,
-             root_bary=np.asarray(table.root_bary),
-             root_node=np.asarray(table.root_node),
-             children=np.asarray(table.children),
-             normal=np.asarray(table.normal),
-             offset=np.asarray(table.offset),
-             leaf_row=np.asarray(table.leaf_row),
-             max_depth=np.asarray(table.max_depth, dtype=np.int64))
+    flat arrays only -- never the multi-GB pickled Tree.  Written
+    atomically (utils/atomic.py tmp+rename, np.savez streaming into
+    the tmp handle -- no in-RAM staging): a crash mid-save leaves the
+    previous complete file, never a torn npz a later deploy would
+    choke on."""
+    from explicit_hybrid_mpc_tpu.utils import atomic
+
+    with atomic.atomic_file(path) as f:
+        np.savez(f,
+                 root_bary=np.asarray(table.root_bary),
+                 root_node=np.asarray(table.root_node),
+                 children=np.asarray(table.children),
+                 normal=np.asarray(table.normal),
+                 offset=np.asarray(table.offset),
+                 leaf_row=np.asarray(table.leaf_row),
+                 max_depth=np.asarray(table.max_depth, dtype=np.int64))
 
 
 def load_descent(path: str) -> DescentTable:

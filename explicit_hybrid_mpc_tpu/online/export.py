@@ -104,27 +104,64 @@ def _field_dtype(name: str):
 
 
 def _write_meta(dir_path: str, n_leaves: int, p: int, n_u: int,
-                provenance: dict | None) -> None:
+                provenance: dict | None,
+                checksums: dict | None = None) -> None:
     """The table's ``meta.json``, including the build-provenance stamp
     (partition/provenance.py) when one is known.  A stamp-less write is
     legal (synthetic trees, tests) -- loaders then treat the table as
-    legacy/unstamped."""
+    legacy/unstamped.
+
+    Written ATOMICALLY and LAST (utils/atomic.py): meta.json is the
+    artifact directory's commit marker -- the field ``.npy`` files
+    stream in place (a memmap cannot), so a crash mid-export leaves
+    either the previous complete meta (describing the previous arrays'
+    shapes, which the loader's structural check then flags) or no new
+    meta at all, never a torn one.  ``checksums`` (field -> sha256
+    hex) rides along so load_leaf_table(verify_checksum=True) can
+    detect at-rest corruption of the arrays themselves."""
     meta = {"n_leaves": int(n_leaves), "p": int(p), "n_u": int(n_u)}
     if provenance is not None:
         meta["provenance"] = provenance
-    with open(os.path.join(dir_path, "meta.json"), "w") as f:
-        json.dump(meta, f)
+    if checksums:
+        meta["checksums"] = checksums
+    from explicit_hybrid_mpc_tpu.utils import atomic
+
+    atomic.atomic_write_json(os.path.join(dir_path, "meta.json"), meta)
+
+
+def _read_meta(dir_path: str) -> dict | None:
+    try:
+        with open(os.path.join(dir_path, "meta.json")) as f:
+            return json.load(f)
+    except OSError:
+        return None  # legacy layout without meta.json
+    except json.JSONDecodeError as e:
+        from explicit_hybrid_mpc_tpu.utils import atomic
+
+        raise atomic.CorruptArtifact(
+            f"{dir_path}/meta.json: unreadable ({e}) -- the artifact "
+            "commit marker is torn; re-export the table or restore a "
+            "previous generation") from e
+
+
+def _field_checksums(dir_path: str) -> dict:
+    """sha256 per field file, read back post-flush (sequential, page
+    cache warm from the write; O(chunk) memory)."""
+    from explicit_hybrid_mpc_tpu.utils import atomic
+
+    return {k: atomic.file_sha256(os.path.join(dir_path, f"{k}.npy"))
+            for k in _LEAF_FIELDS}
 
 
 def load_table_provenance(dir_path: str) -> dict | None:
     """The provenance stamp of an exported table directory, or None for
     legacy/stamp-less tables (missing meta.json included -- the arrays
-    alone are still a loadable table)."""
-    try:
-        with open(os.path.join(dir_path, "meta.json")) as f:
-            return json.load(f).get("provenance")
-    except (OSError, json.JSONDecodeError):
-        return None
+    alone are still a loadable table).  A PRESENT-but-torn meta.json
+    raises CorruptArtifact (_read_meta): treating a corrupt commit
+    marker as merely 'legacy' would wave a damaged artifact through
+    the provenance guard."""
+    meta = _read_meta(dir_path)
+    return None if meta is None else meta.get("provenance")
 
 
 def export_leaves(tree: Tree, chunk: int = DEFAULT_CHUNK) -> LeafTable:
@@ -141,17 +178,62 @@ def export_leaves(tree: Tree, chunk: int = DEFAULT_CHUNK) -> LeafTable:
     return out
 
 
+def commit_leaf_table(dir_path: str, n_leaves: int, p: int, n_u: int,
+                      provenance: dict | None = None,
+                      checksum: bool = True) -> None:
+    """Write the artifact directory's COMMIT MARKER (meta.json,
+    atomic, with optional per-field sha256s) and fire the
+    artifact.written injection site.  Split out of write_leaf_table so
+    a multi-file artifact (save_artifacts: leaf table + descent.npz)
+    can land EVERY file before the marker commits -- a crash between
+    the table and the descent write must leave a directory the loader
+    rejects as uncommitted, never a 'valid' table pointing at a
+    missing or stale descent."""
+    _write_meta(dir_path, n_leaves, p, n_u, provenance,
+                checksums=_field_checksums(dir_path) if checksum
+                else None)
+    # At-rest-corruption injection site (faults/plan.py): `corrupt`
+    # kinds mangle the largest field so the loader's rejection path is
+    # exercised end to end.
+    from explicit_hybrid_mpc_tpu.faults import injector as faults_inj
+
+    faults_inj.fire("artifact.written", label=dir_path,
+                    path=os.path.join(dir_path, "bary_M.npy"))
+
+
+def invalidate_meta(dir_path: str) -> None:
+    """Remove the commit marker before re-exporting INTO an existing
+    artifact directory: the field files are rewritten in place (a
+    memmap cannot write elsewhere), and a crash mid-rewrite must not
+    leave the OLD meta.json 'committing' a half-new table.  (The
+    resulting marker-less directory loads as legacy -- the documented
+    weak spot for pre-meta layouts -- but never as a falsely-committed
+    one.)"""
+    try:
+        os.unlink(os.path.join(dir_path, "meta.json"))
+    except FileNotFoundError:
+        pass
+
+
 def write_leaf_table(tree: Tree, dir_path: str,
                      chunk: int = DEFAULT_CHUNK,
-                     provenance: dict | None = None) -> LeafTable:
+                     provenance: dict | None = None,
+                     checksum: bool = True,
+                     commit: bool = True) -> LeafTable:
     """Stream the leaf table into memory-mapped ``<dir>/<field>.npy``
     files; peak additional RSS is O(chunk), so a built tree can be
     exported next to itself without doubling host memory.  Returns the
     memmap-backed table (flushed; reopen with load_leaf_table for a
     clean read-only mapping).  ``provenance`` defaults to the tree's
-    own build stamp and lands in ``meta.json``."""
+    own build stamp and lands in ``meta.json``.  ``checksum=False``
+    skips the per-field sha256 pass (a full re-read; turn it off for
+    cluster-scale exports where the structural check suffices).
+    ``commit=False`` defers the meta.json commit marker -- callers
+    adding MORE files to the artifact (registry.save_artifacts)
+    commit once everything is on disk (commit_leaf_table)."""
     ids = _leaf_ids(tree)
     os.makedirs(dir_path, exist_ok=True)
+    invalidate_meta(dir_path)
     shapes = _field_shapes(tree, ids.size)
     out = LeafTable(**{
         k: np.lib.format.open_memmap(
@@ -163,12 +245,15 @@ def write_leaf_table(tree: Tree, dir_path: str,
         a.flush()
     if provenance is None:
         provenance = getattr(tree, "provenance", None)
-    _write_meta(dir_path, ids.size, tree.p, tree.n_u, provenance)
+    if commit:
+        commit_leaf_table(dir_path, ids.size, tree.p, tree.n_u,
+                          provenance, checksum=checksum)
     return out
 
 
 def save_leaf_table(table: LeafTable, dir_path: str,
-                    provenance: dict | None = None) -> None:
+                    provenance: dict | None = None,
+                    checksum: bool = True) -> None:
     """Persist an already-materialized table (same layout as
     write_leaf_table; prefer that for large trees -- it never holds the
     full table in RAM)."""
@@ -176,31 +261,77 @@ def save_leaf_table(table: LeafTable, dir_path: str,
     for k in _LEAF_FIELDS:
         np.save(os.path.join(dir_path, f"{k}.npy"), getattr(table, k))
     _write_meta(dir_path, table.n_leaves, table.bary_M.shape[1] - 1,
-                table.U.shape[2], provenance)
+                table.U.shape[2], provenance,
+                checksums=_field_checksums(dir_path) if checksum
+                else None)
 
 
 def load_leaf_table(dir_path: str, mmap: bool = True,
                     expect_provenance: dict | None = None,
-                    strict: bool = False) -> LeafTable:
+                    strict: bool = False,
+                    verify_checksum: bool = False) -> LeafTable:
     """Load an exported table; ``mmap=True`` maps the files read-only
     (pages fault in on demand -- the online stage working set, not L,
     bounds RSS), ``mmap=False`` reads full copies.
+
+    Integrity (docs/robustness.md): an unreadable field file or a
+    row-count mismatch against ``meta.json`` (the commit marker a torn
+    export leaves stale or absent) raises ``CorruptArtifact`` with a
+    clear message instead of shipping truncated tables into serving;
+    ``verify_checksum=True`` additionally re-hashes every field
+    against the recorded sha256s (a full read -- deploy-time
+    paranoia, not the request path).  Legacy meta-less layouts load
+    as before.
 
     ``expect_provenance``: the build stamp the caller believes this
     table carries (partition/provenance.build_stamp).  A mismatch warns
     by default and raises ``ProvenanceMismatch`` under ``strict`` --
     the guard against deploying/reusing a table against a revised
     problem.  Legacy stamp-less tables warn and load."""
+    from explicit_hybrid_mpc_tpu.utils import atomic
+
+    meta = _read_meta(dir_path)
     if expect_provenance is not None:
         from explicit_hybrid_mpc_tpu.partition import provenance as prov
 
-        prov.check_stamp(load_table_provenance(dir_path),
+        prov.check_stamp((meta or {}).get("provenance"),
                          expect_provenance, where=dir_path,
                          strict=strict)
+    if verify_checksum:
+        sums = (meta or {}).get("checksums")
+        if not sums:
+            raise atomic.CorruptArtifact(
+                f"{dir_path}: verify_checksum requested but meta.json "
+                "records no checksums (legacy export or "
+                "checksum=False write)")
+        for k, want in sums.items():
+            got = atomic.file_sha256(os.path.join(dir_path, f"{k}.npy"))
+            if got != want:
+                raise atomic.CorruptArtifact(
+                    f"{dir_path}/{k}.npy: sha256 mismatch (recorded "
+                    f"{want[:12]}.., found {got[:12]}..) -- the field "
+                    "file was corrupted after export; re-export or "
+                    "restore")
     mode = "r" if mmap else None
-    return LeafTable(*(np.load(os.path.join(dir_path, f"{k}.npy"),
-                               mmap_mode=mode)
-                       for k in _LEAF_FIELDS))
+    arrs = []
+    for k in _LEAF_FIELDS:
+        p = os.path.join(dir_path, f"{k}.npy")
+        try:
+            arrs.append(np.load(p, mmap_mode=mode))
+        except (OSError, ValueError, EOFError) as e:
+            raise atomic.CorruptArtifact(
+                f"{p}: unreadable leaf-table field ({e}) -- the "
+                "artifact is truncated or torn; re-export the table "
+                "or restore a previous generation") from e
+    table = LeafTable(*arrs)
+    if meta is not None and "n_leaves" in meta:
+        for k, a in zip(_LEAF_FIELDS, table):
+            if a.shape[0] != meta["n_leaves"]:
+                raise atomic.CorruptArtifact(
+                    f"{dir_path}/{k}.npy holds {a.shape[0]} rows but "
+                    f"meta.json committed {meta['n_leaves']}: the "
+                    "export was torn mid-write; re-export or restore")
+    return table
 
 
 def semi_explicit_mask(tree: Tree, table: LeafTable) -> np.ndarray:

@@ -47,6 +47,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from explicit_hybrid_mpc_tpu import obs as obs_lib
+from explicit_hybrid_mpc_tpu.faults import injector as faults_inj
 from explicit_hybrid_mpc_tpu.oracle import ipm
 from explicit_hybrid_mpc_tpu.problems.base import CanonicalMPQP
 
@@ -334,6 +335,13 @@ def _solve_simplex_min_one(prob: DeviceProblem, bary_M: jax.Array,
 
 class Oracle:
     """Solver plugin boundary with selectable backend."""
+
+    # Fault-injection role tag carried in the oracle.dispatch site
+    # label: "primary" for the build's oracle, "fallback" on the CPU
+    # recovery twin (frontier._fallback_oracle flips it) -- so a
+    # scripted "dead device" plan can target the primary without also
+    # failing the very oracle that exists to recover from it.
+    _fault_role = "primary"
 
     def __init__(self, problem, backend: str = "cpu", n_iter: int = 30,
                  mesh=None, precision: str = "f64",
@@ -1013,6 +1021,14 @@ class Oracle:
         P = thetas.shape[0]
         if P == 0:
             return ("empty",)
+        # Fault-injection site (faults/injector.py; a global None-test
+        # when no plan is installed): a scripted dispatch-time device
+        # error raises here and is wrapped into a ("failed", e) handle
+        # by the pipeline, exactly like a real dead-tunnel raise.  The
+        # label carries the oracle's ROLE so a "dead device" plan
+        # (match "primary") does not also fail the CPU recovery twin.
+        faults_inj.fire("oracle.dispatch",
+                        label="vertices:" + self._fault_role)
         # Solve counters increment at WAIT time, not here: a dispatched-
         # but-never-consumed prefetch (end-of-budget, or in-flight at a
         # checkpoint) must not make a resumed build's solve counts
@@ -1606,6 +1622,9 @@ class Oracle:
         K = thetas.shape[0]
         if K == 0:
             return ("empty",)
+        # Fault-injection site (see dispatch_vertices).
+        faults_inj.fire("oracle.dispatch",
+                        label="pairs:" + self._fault_role)
         delta_idx = np.asarray(delta_idx, dtype=np.int64)
         # Counters increment at wait time (see dispatch_vertices).
         if self.backend == "serial":

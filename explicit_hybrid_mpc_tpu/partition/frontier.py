@@ -38,10 +38,13 @@ import collections
 import os
 import pickle
 import time
+import warnings
 
 import numpy as np
 
+from explicit_hybrid_mpc_tpu import faults as faults_lib
 from explicit_hybrid_mpc_tpu import obs as obs_lib
+from explicit_hybrid_mpc_tpu.utils import atomic
 from explicit_hybrid_mpc_tpu.config import PartitionConfig
 from explicit_hybrid_mpc_tpu.oracle.oracle import Oracle
 from explicit_hybrid_mpc_tpu.partition import certify, geometry
@@ -308,6 +311,18 @@ class FrontierEngine:
         shared by __init__ and resume().  Both are None by default, and
         every hook below is guarded on that None, so the obs='off' fast
         path gains no per-step work."""
+        # Bounded-recovery policy + fault-injection hookup
+        # (faults/policy.py, faults/injector.py; docs/robustness.md).
+        # install_from_config is a no-op returning None unless
+        # cfg.fault_plan / EHM_FAULT_PLAN name a plan (or a test's
+        # activate() block already installed one).
+        self._policy = faults_lib.RetryPolicy.from_config(self.cfg)
+        self._injector = faults_lib.install_from_config(self.cfg,
+                                                        obs=self.obs)
+        # Poison-cell quarantine ledger + permanent-CPU-degrade flag
+        # (session-local, like n_device_failures).
+        self.n_quarantined_cells = 0
+        self._degraded = False
         self.recorder = None
         # recorder_dir implies obs_recorder at EVERY entry point (the
         # CLI applies the same rule): naming a bundle directory while
@@ -498,47 +513,56 @@ class FrontierEngine:
         (SOCOracle) fall back to THEMSELVES, not the plain QP kernel."""
         if self._fb_oracle is None:
             self._fb_oracle = self.oracle.cpu_twin(self.problem)
+            # Injection-site role tag: "dead device" fault plans match
+            # the primary's dispatches, not the recovery twin's.
+            self._fb_oracle._fault_role = "fallback"
         return self._fb_oracle
 
     def _oracle_call(self, method: str, *args):
-        """Issue an oracle query; on a device failure (dead TPU tunnel,
-        OOM, interconnect error) retry the SAME batch on the host-CPU
-        fallback oracle instead of aborting the whole build (round-1
-        postmortem: one backend outage voided the benchmark capture).
-        The event is logged; solve counts are folded into the main
+        """Issue an oracle query under the bounded-recovery policy
+        (faults/policy.py): on a device failure (dead TPU tunnel, OOM,
+        interconnect error) or a solve timeout, retry the SAME batch on
+        the host-CPU fallback oracle with exponential backoff instead
+        of aborting the whole build (round-1 postmortem: one backend
+        outage voided the benchmark capture); if every attempt fails
+        the batch's cells are QUARANTINED (_quarantine) and the build
+        continues.  Once the device-failure cap trips, the engine is
+        DEGRADED and queries route straight to the CPU twin -- a dead
+        accelerator costs the fail-then-fallback tax once, not
+        per-batch.  Events are logged; solve counts fold into the main
         oracle's statistics."""
         t0 = time.perf_counter()
         try:
-            # The span doubles as a device-trace annotation under
-            # obs='full', anchoring each synchronous oracle query on the
-            # host track of a jax.profiler capture.
-            with self.obs.span("oracle." + method):
-                return getattr(self.oracle, method)(*args)
-        except (RuntimeError, OSError) as e:
-            # XlaRuntimeError (dead tunnel, device OOM, interconnect
-            # faults) subclasses RuntimeError; socket/tunnel drops raise
-            # OSError.  Deterministic programming errors (TypeError/
-            # ValueError/shape bugs) propagate instead of being retried on
-            # the fallback, where they would resurface as a second failure
-            # mislabeled 'device_failure' (round-2 advisor item).
-            self.n_device_failures += 1
-            self.log.emit(device_failure=repr(e)[:500], query=method,
-                          retry_backend="cpu")
-            self._health_device_failure(e)
-            fb = self._fallback_oracle()
-            before = fb.stat_snapshot()
-            out = getattr(fb, method)(*args)
-            # Fold EVERY additive stat (solve counts, iteration ledger,
-            # cohort/warm-start counters) so the exact-accounting
-            # figures survive partial device fallback.
-            self.oracle.fold_stats(fb, before)
-            if self.recorder is not None:
-                try:  # diagnostics must never break the fallback path
-                    self._capture_oracle_failure(method, args, out,
-                                                 repr(e))
-                except Exception:  # tpulint: disable=silent-except -- diag
-                    pass
-            return out
+            if not self._degraded:
+                try:
+                    # The span doubles as a device-trace annotation
+                    # under obs='full', anchoring each synchronous
+                    # oracle query on the host track of a jax.profiler
+                    # capture.  The fault hook sits INSIDE the timed
+                    # callable so an injected hang is seen by the
+                    # watchdog exactly like a wedged real solve.
+                    with self.obs.span("oracle." + method):
+                        def _go():
+                            faults_lib.fire("oracle.call", label=method)
+                            return getattr(self.oracle, method)(*args)
+
+                        return faults_lib.call_with_timeout(
+                            _go, self._policy.solve_timeout_s)
+                except (RuntimeError, OSError) as e:
+                    # XlaRuntimeError (dead tunnel, device OOM,
+                    # interconnect faults) subclasses RuntimeError;
+                    # socket/tunnel drops raise OSError; SolveTimeout is
+                    # a RuntimeError by design.  Deterministic
+                    # programming errors (TypeError/ValueError/shape
+                    # bugs) propagate instead of being retried on the
+                    # fallback, where they would resurface as a second
+                    # failure mislabeled 'device_failure' (round-2
+                    # advisor item).
+                    self._note_device_failure(method, e)
+                    err: BaseException | None = e
+            else:
+                err = None
+            return self._recover(method, args, err)
         finally:
             self._oracle_s += time.perf_counter() - t0
 
@@ -843,43 +867,178 @@ class FrontierEngine:
                                        lamr, sr))
 
     def _wait_or_fallback(self, kind: str, handle, args: tuple):
-        """Resolve one dispatched part; on device failure re-solve the
-        same batch synchronously on the CPU fallback oracle."""
-        try:
-            if isinstance(handle, tuple) and len(handle) == 2 \
-                    and handle[0] == "failed":
-                raise handle[1]
-            if kind == "vertices":
-                return self.oracle.wait_vertices(handle)
-            if kind == "pairs_full":
-                return self.oracle.wait_pairs_full(handle)
-            return self.oracle.wait_pairs(handle)
-        except (RuntimeError, OSError) as e:
-            self.n_device_failures += 1
-            self.log.emit(device_failure=repr(e)[:500],
-                          query=f"dispatch_{kind}", retry_backend="cpu")
-            self._health_device_failure(e)
+        """Resolve one dispatched part; on device failure (or solve
+        timeout) re-solve the same batch on the CPU fallback oracle
+        under the bounded-recovery policy (_recover: backoff retries,
+        then quarantine).  ("degraded", ...) handles -- minted by the
+        pipeline once the device-failure cap tripped -- skip the
+        device wait AND the failure bookkeeping: the degraded engine
+        routes straight to the twin without re-failing per batch."""
+        if not (isinstance(handle, tuple) and handle
+                and handle[0] == "degraded"):
+            try:
+                if isinstance(handle, tuple) and len(handle) == 2 \
+                        and handle[0] == "failed":
+                    raise handle[1]
+
+                def _go():
+                    faults_lib.fire("oracle.wait", label=kind)
+                    if kind == "vertices":
+                        return self.oracle.wait_vertices(handle)
+                    if kind == "pairs_full":
+                        return self.oracle.wait_pairs_full(handle)
+                    return self.oracle.wait_pairs(handle)
+
+                return faults_lib.call_with_timeout(
+                    _go, self._policy.solve_timeout_s)
+            except (RuntimeError, OSError) as e:
+                self._note_device_failure(f"dispatch_{kind}", e)
+                err: BaseException | None = e
+        else:
+            err = None
+        return self._recover(kind, args, err)
+
+    # _recover kind -> quarantine-synthesis kind (oracle method names
+    # normalize to the wait-kind vocabulary of synthesize_failure).
+    _SYNTH_KIND = {"solve_vertices": "vertices",
+                   "solve_pairs": "pairs",
+                   "solve_pairs_full": "pairs_full"}
+
+    def _fb_call(self, fb: Oracle, kind: str, args: tuple):
+        """One fallback attempt on the CPU twin.  The twin mirrors
+        two_phase/warm_start (cpu_twin), so a pairs_full re-solve
+        consumes the same warm donors and returns the same extended
+        tuple -- results stay bit-compatible with the device's."""
+        if kind == "vertices":
+            return fb.solve_vertices(*args)
+        if kind == "pairs_full":
+            return fb.solve_pairs_full(args[0], args[1], warm=args[2])
+        if kind == "pairs":
+            return fb.solve_pairs(*args)
+        return getattr(fb, kind)(*args)
+
+    def _recover(self, kind: str, args: tuple,
+                 err: BaseException | None):
+        """Bounded CPU-twin retries with exponential backoff; poison-
+        cell quarantine on exhaustion.  `err` is the device-side
+        failure that routed us here (None on the degraded fast path --
+        no failure to capture, the device is simply out of rotation).
+
+        Every additive stat (solve counts, iteration ledger, cohort/
+        warm-start counters) folds into the main oracle so the
+        exact-accounting figures survive partial device fallback."""
+        pol = self._policy
+        last = err
+        for attempt in range(pol.max_attempts):
+            if attempt:
+                time.sleep(pol.backoff(attempt - 1))
             fb = self._fallback_oracle()
             before = fb.stat_snapshot()
-            if kind == "vertices":
-                out = fb.solve_vertices(*args)
-            elif kind == "pairs_full":
-                # The twin mirrors two_phase/warm_start (cpu_twin), so
-                # the re-solve consumes the same warm donors and returns
-                # the same extended tuple.
-                out = fb.solve_pairs_full(args[0], args[1], warm=args[2])
-            else:
-                out = fb.solve_pairs(*args)
-            # Fold every additive stat (see Oracle._FOLD_STATS), not
-            # just solve counts: the iteration ledger backs the
-            # documented-exact ipm_iters/wasted_iter_frac figures.
+            try:
+                def _go():
+                    faults_lib.fire("oracle.fallback", label=kind)
+                    return self._fb_call(fb, kind, args)
+
+                out = faults_lib.call_with_timeout(
+                    _go, pol.fallback_timeout())
+            except (RuntimeError, OSError) as e:
+                last = e
+                continue
             self.oracle.fold_stats(fb, before)
-            if self.recorder is not None:
+            if err is not None and self.recorder is not None:
                 try:  # diagnostics must never break the fallback path
-                    self._capture_device_failure(kind, args, out, repr(e))
+                    if kind in ("vertices", "pairs", "pairs_full"):
+                        self._capture_device_failure(kind, args, out,
+                                                     repr(err))
+                    else:
+                        self._capture_oracle_failure(kind, args, out,
+                                                     repr(err))
                 except Exception:  # tpulint: disable=silent-except -- diag
                     pass
             return out
+        return self._quarantine(kind, args, last)
+
+    def _note_device_failure(self, query: str, e: BaseException) -> None:
+        """Shared device-failure bookkeeping: counters, log, health
+        feed -- and the permanent-CPU degrade once the cap trips
+        (cfg.device_failure_cap): from then on _oracle_call routes
+        straight to the twin and the pipeline mints ("degraded", ...)
+        handles instead of dispatching to the dead device, so a lost
+        accelerator costs the fail-then-fallback tax ONCE instead of
+        on every remaining batch (the old _wait_or_fallback retried
+        the device forever)."""
+        self.n_device_failures += 1
+        self.log.emit(device_failure=repr(e)[:500], query=query,
+                      retry_backend="cpu")
+        self._health_device_failure(e)
+        if not self._degraded \
+                and self.n_device_failures >= self._policy.device_failure_cap:
+            self._degraded = True
+            self.log.emit(device_degraded=True,
+                          failures=self.n_device_failures)
+            rec = self.obs.event(
+                "faults.device_degraded",
+                failures=self.n_device_failures,
+                cap=self._policy.device_failure_cap,
+                msg="device failure cap reached: all further oracle "
+                    "work routes to the CPU twin")
+            if self._health is not None:
+                self._health.feed(rec or {
+                    "kind": "event", "name": "faults.device_degraded"})
+
+    def _quarantine(self, kind: str, args: tuple,
+                    err: BaseException | None):
+        """Every recovery attempt failed: synthesize the conservative
+        no-information result for the batch (faults/policy.py -- +inf
+        unconverged points, -inf no-bound simplex rows, no Farkas
+        certificates), record the poison cells, and let the build
+        continue.  Sound by construction: synthesized values can only
+        cause extra subdivision or uncertified leaves, never a wrong
+        certificate."""
+        out, n_cells = faults_lib.synthesize_failure(
+            self._SYNTH_KIND.get(kind, kind), args, self.oracle)
+        self.n_quarantined_cells += n_cells
+        self.log.emit(quarantine=kind, cells=n_cells,
+                      error=repr(err)[:300] if err else None)
+        rec = self.obs.event("faults.quarantine", query=kind,
+                             cells=n_cells,
+                             error=repr(err)[:200] if err else None)
+        if self.obs.enabled:
+            self.obs.metrics.counter(
+                "build.quarantined_cells").inc(n_cells)
+        if self._health is not None:
+            self._health.feed(rec or {"kind": "event",
+                                      "name": "faults.quarantine"})
+        if self.recorder is not None:
+            try:  # diagnostics must never break the quarantine path
+                self._capture_quarantine(kind, args, err)
+            except Exception:  # tpulint: disable=silent-except -- diag
+                pass
+        return out
+
+    def _capture_quarantine(self, kind: str, args: tuple,
+                            err: BaseException | None) -> None:
+        """Repro bundle for a quarantined batch: the exact inputs every
+        recovery attempt failed on (scripts/replay_solve.py re-solves
+        them standalone -- the poison-cell triage entry point)."""
+        from explicit_hybrid_mpc_tpu.obs import recorder as rec_lib
+
+        cap = self._MAX_FAILURE_ROWS
+        arrays = dict(rec_lib.canonical_arrays(self.oracle.can))
+        a0 = np.asarray(args[0])[:cap]
+        if kind in ("vertices", "pairs", "pairs_full"):
+            arrays["thetas"] = a0
+        else:
+            arrays["bary_Ms"] = a0
+        if len(args) > 1 and args[1] is not None:
+            arrays["delta_idx"] = np.asarray(args[1],
+                                             dtype=np.int64)[:cap]
+        self.recorder.dump(
+            "quarantine", arrays,
+            {"kind": "quarantine", "query": kind,
+             "oracle": rec_lib.oracle_meta(self.oracle),
+             "backend": self.oracle.backend,
+             "error": repr(err)[:500] if err else None})
 
     def _gather_batch(self, nodes: list[int]) -> tuple[dict, tuple]:
         """Vertex data for the whole batch: ONE cache lookup per unique
@@ -925,6 +1084,9 @@ class FrontierEngine:
     # -- one frontier step -------------------------------------------------
 
     def step(self) -> None:
+        # Crash-at-step injection site (chaos testing; a None-test
+        # when no plan is installed).
+        faults_lib.fire("build.step", label=str(self.steps))
         t_step = time.perf_counter()
         self._oracle_s = 0.0
         B = min(len(self.frontier), self.cfg.batch_simplices)
@@ -1413,6 +1575,13 @@ class FrontierEngine:
                 self._pipe.spec_waste_frac(self.oracle.n_point_solves),
                 4),
             "device_failures": self.n_device_failures,
+            # Poison-cell quarantine (faults/policy.py): cells whose
+            # every recovery attempt failed and that were closed with
+            # synthesized no-information results.  0 on any healthy
+            # run; the chaos acceptance config requires 0 too (every
+            # injected fault must be RECOVERED, not given up on).
+            "quarantined_cells": self.n_quarantined_cells,
+            "device_degraded": bool(self._degraded),
             "cache_peak_vertices": self.cache.peak_vertices,
             "cache_peak_mb": round(self.cache.peak_bytes / 2**20, 2),
             "cache_live_vertices": len(self.cache),
@@ -1442,32 +1611,54 @@ class FrontierEngine:
 
         if not distributed.is_frontier_owner():
             return
-        with open(path, "wb") as f:
-            pickle.dump({
-                "tree": self.tree, "roots": self.roots,
-                "frontier": list(self.frontier),
-                "cache": self.cache._d, "steps": self.steps,
-                "n_uncertified": self.n_uncertified,
-                "n_semi_explicit": self.n_semi_explicit,
-                "n_unique_solves": self.n_unique_solves,
-                "n_solves": self.oracle.n_solves,
-                "n_point_solves": self.oracle.n_point_solves,
-                "n_simplex_solves": self.oracle.n_simplex_solves,
-                "n_rescue_solves": self.oracle.n_rescue_solves,
-                # Inherited per-delta bounds are part of frontier state:
-                # dropping them on resume would be sound (they are an
-                # optimization) but would break resumed-equals-straight
-                # solve-count parity.
-                "inherit": {n: self._inherit[n] for n in self.frontier
-                            if n in self._inherit},
-                "n_inherited_skips": self.n_inherited_skips,
-                "n_point_skips": self.n_point_skips,
-                "cfg": self.cfg,
-                # Duplicates the tree's own stamp at the top level so a
-                # checkpoint's provenance is inspectable without paying
-                # the multi-hundred-MB tree unpickle.
-                "provenance": getattr(self.tree, "provenance", None),
-            }, f, protocol=pickle.HIGHEST_PROTOCOL)
+        snap = {
+            "tree": self.tree, "roots": self.roots,
+            "frontier": list(self.frontier),
+            "cache": self.cache._d, "steps": self.steps,
+            "n_uncertified": self.n_uncertified,
+            "n_semi_explicit": self.n_semi_explicit,
+            "n_unique_solves": self.n_unique_solves,
+            "n_solves": self.oracle.n_solves,
+            "n_point_solves": self.oracle.n_point_solves,
+            "n_simplex_solves": self.oracle.n_simplex_solves,
+            "n_rescue_solves": self.oracle.n_rescue_solves,
+            # Inherited per-delta bounds are part of frontier state:
+            # dropping them on resume would be sound (they are an
+            # optimization) but would break resumed-equals-straight
+            # solve-count parity.
+            "inherit": {n: self._inherit[n] for n in self.frontier
+                        if n in self._inherit},
+            "n_inherited_skips": self.n_inherited_skips,
+            "n_point_skips": self.n_point_skips,
+            "cfg": self.cfg,
+            # Duplicates the tree's own stamp at the top level so a
+            # checkpoint's provenance is inspectable without paying
+            # the multi-hundred-MB tree unpickle.
+            "provenance": getattr(self.tree, "provenance", None),
+        }
+        # Two-generation rotation + checksummed atomic write
+        # (utils/atomic.py): the current valid checkpoint becomes
+        # `.prev` and the new one STREAMS via tmp+fsync+rename behind
+        # a content-checksum header (no full-payload byte string in
+        # RAM -- the tree is the process's largest object), so a crash
+        # at ANY instant leaves at least one loadable generation on
+        # disk and at-rest corruption is detected at load
+        # (load_checkpoint falls back to `.prev` on a rejected file).
+        # A pickling failure mid-stream deletes the tmp and leaves
+        # `.prev` as the newest generation -- strictly better than the
+        # old in-place pickle.dump, which tore the primary.
+        if os.path.exists(path):
+            os.replace(path, path + ".prev")
+        # Kill-mid-checkpoint injection site: a crash HERE (after
+        # rotation, before the write) is the worst-ordered torn
+        # checkpoint -- only `.prev` survives, which is exactly what
+        # the generation fallback exists for (chaos schedule 3).
+        faults_lib.fire("checkpoint.write", label=os.path.basename(path))
+        atomic.atomic_pickle(path, snap)
+        # At-rest corruption site: `corrupt` kinds mangle the landed
+        # file so the loader's checksum rejection is exercised.
+        faults_lib.fire("checkpoint.written",
+                        label=os.path.basename(path), path=path)
 
     @classmethod
     def resume(cls, snapshot: str | dict, problem, oracle: Oracle,
@@ -1482,8 +1673,7 @@ class FrontierEngine:
         if isinstance(snapshot, dict):
             snap = snapshot
         else:
-            with open(snapshot, "rb") as f:
-                snap = pickle.load(f)
+            snap = load_checkpoint(snapshot)
         eng = cls.__new__(cls)
         eng.problem = problem
         eng.oracle = oracle
@@ -1576,6 +1766,45 @@ class FrontierEngine:
             if k not in eng._refcount:
                 eng.cache.evict_key(k)
         return eng
+
+
+def load_checkpoint(path: str, fallback: bool = True) -> dict:
+    """Load a build checkpoint with integrity verification and
+    previous-generation fallback (docs/robustness.md "Crash-safe
+    writes").
+
+    The primary path is verified against its content-checksum trailer
+    (legacy stamp-less checkpoints load with a clear conscience --
+    pickle-decodability is their only check); a truncated, torn, or
+    bit-flipped file is REJECTED with ``atomic.CorruptArtifact`` and,
+    when ``fallback`` is on, the ``.prev`` generation rotated aside by
+    ``save_checkpoint`` is tried next (with a warning naming both
+    files).  Only when no candidate loads does the error propagate --
+    listing every file tried and why it was rejected, so the operator
+    is never left diagnosing a bare UnpicklingError at 3 a.m."""
+    tried: list[str] = []
+    cands = [path] + ([path + ".prev"] if fallback else [])
+    for p in cands:
+        if not os.path.exists(p):
+            tried.append(f"{p}: missing")
+            continue
+        try:
+            obj, _checked = atomic.read_checked_pickle(p)
+        except atomic.CorruptArtifact as e:
+            tried.append(str(e))
+            continue
+        if not isinstance(obj, dict) or "tree" not in obj:
+            tried.append(f"{p}: not a build checkpoint")
+            continue
+        if p != path:
+            warnings.warn(
+                f"checkpoint {path} is unusable "
+                f"({tried[-1] if tried else 'missing'}); falling back "
+                f"to the previous generation {p}", RuntimeWarning,
+                stacklevel=2)
+        return obj
+    raise atomic.CorruptArtifact(
+        "no valid checkpoint generation: " + "; ".join(tried))
 
 
 def make_oracle(problem, cfg: PartitionConfig, mesh=None,

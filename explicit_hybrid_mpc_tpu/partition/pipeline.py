@@ -347,8 +347,14 @@ class BuildPipeline:
     def _dispatch(self, kind: str, a, b, warm):
         """Non-blocking oracle dispatch; a dispatch-time device error is
         recorded in the handle and rerouted to the CPU fallback at
-        resolve time (same contract as the old prefetch path)."""
+        resolve time (same contract as the old prefetch path).  A
+        DEGRADED engine (device-failure cap tripped,
+        frontier._note_device_failure) mints a ("degraded", kind)
+        handle instead of touching the dead device at all: the wait
+        routes straight to the CPU twin with no per-batch re-failure."""
         eng = self.eng
+        if eng._degraded:
+            return ("degraded", kind)
 
         def go():
             if kind == "grid":

@@ -127,8 +127,16 @@ def _load_prior(prior) -> tuple[Tree, dict, str]:
             f"{prior} is an artifact directory without a tree.pkl: "
             "flat leaf tables carry no tree structure to transfer -- "
             "pass the build's .tree.pkl or .ckpt.pkl instead")
-    with open(prior, "rb") as f:
-        obj = pickle.load(f)
+    from explicit_hybrid_mpc_tpu.utils import atomic
+
+    try:
+        obj, _checked = atomic.read_checked_pickle(prior)
+    except atomic.CorruptArtifact as e:
+        raise RebuildError(
+            f"prior {prior} failed its integrity check ({e}): a "
+            "truncated/corrupt prior transfers garbage structure -- "
+            "restore a previous generation (.prev for checkpoints) or "
+            "run a cold build") from e
     if isinstance(obj, Tree):
         return obj, {}, "tree"
     if isinstance(obj, dict) and "tree" in obj:
@@ -338,6 +346,12 @@ def warm_rebuild(problem, cfg: PartitionConfig, prior,
         provenance_changed          field-level prior-vs-new stamp diff
     """
     t0 = time.perf_counter()
+    # Fault-injection site (faults/injector.py): scripted failures at
+    # the rebuild boundary -- the sweep inherits the engine's full
+    # bounded-recovery policy for everything downstream.
+    from explicit_hybrid_mpc_tpu.faults import injector as faults_inj
+
+    faults_inj.fire("rebuild.sweep")
     prior_tree, prior_cache, src = _load_prior(prior)
     prior_stamp = getattr(prior_tree, "provenance", None)
     if strict_provenance and prior_stamp is None:

@@ -625,15 +625,23 @@ class Tree:
                 semi_explicit=getattr(ld, "semi_explicit", False)))
 
     def save(self, path: str) -> None:
-        """Pickle to disk (the reference pickles its tree; SURVEY.md
-        section 3 [M-high], UNVERIFIED)."""
-        with open(path, "wb") as f:
-            pickle.dump(self, f, protocol=pickle.HIGHEST_PROTOCOL)
+        """Atomic checksummed pickle (utils/atomic.py): tmp + fsync +
+        rename with a content-checksum trailer, so a crash mid-save
+        never tears the tree a later rebuild/deploy trusts.  (The
+        reference pickles its tree in place; SURVEY.md section 3
+        [M-high], UNVERIFIED.)"""
+        from explicit_hybrid_mpc_tpu.utils import atomic
+
+        atomic.atomic_pickle(path, self)
 
     @staticmethod
     def load(path: str) -> "Tree":
-        with open(path, "rb") as f:
-            tree = pickle.load(f)
+        """Load with integrity verification: a checksummed pickle is
+        verified (CorruptArtifact on mismatch/truncation, with a clear
+        message); legacy trailer-less pickles load as before."""
+        from explicit_hybrid_mpc_tpu.utils import atomic
+
+        tree, _checked = atomic.read_checked_pickle(path)
         if not isinstance(tree, Tree):
             raise TypeError(f"{path} does not contain a Tree")
         return tree

@@ -38,6 +38,15 @@ from typing import Optional
 import numpy as np
 
 from explicit_hybrid_mpc_tpu import obs as obs_lib
+from explicit_hybrid_mpc_tpu.faults import injector as faults_inj
+
+#: Default drain-wait budget (seconds) before ``wait_retired`` gives
+#: up and emits ``health.lease_leak``: a retiring version still leased
+#: after this long almost certainly belongs to a dead thread (the
+#: lease release runs in the context manager's finally, so only a
+#: thread killed MID-LEASE can leak one), and a deploy loop blocked on
+#: it forever is strictly worse than an alarm.
+DEFAULT_RETIRE_WAIT_S = 30.0
 
 
 class ControllerVersion:
@@ -96,6 +105,11 @@ class ControllerRegistry:
         the previous version (if any) retires after its in-flight
         leases drain.  Returns the new version handle.
 
+        A scripted publish fault (faults/plan.py ``registry.publish``
+        site) fires BEFORE any mutation: an injected swap crash leaves
+        the registry serving the old version intact -- the atomicity
+        the chaos tests pin.
+
         The parameter width is an INVARIANT of the controller name:
         publishing a version whose descent table has a different p
         raises.  Queued submissions are width-validated against the
@@ -103,6 +117,7 @@ class ControllerRegistry:
         would let already-validated rows reach a later lease's
         evaluator (and fail every co-batched ticket); a different-width
         tree is a different controller -- deploy it under a new name."""
+        faults_inj.fire("registry.publish", label=name)
         retire_now = None
         with self._lock:
             old = self._active.get(name)
@@ -210,8 +225,30 @@ class ControllerRegistry:
     def wait_retired(self, ver: ControllerVersion,
                      timeout: Optional[float] = None) -> bool:
         """Block until `ver` has fully drained (swap verification /
-        tests); True when retired within `timeout`."""
-        return ver._retired_evt.wait(timeout)
+        deploy loops); True when retired within `timeout`.
+
+        `timeout` defaults to DEFAULT_RETIRE_WAIT_S rather than
+        forever: a lease pinned by a dead scheduler thread used to
+        block this call indefinitely -- now the expiry emits a
+        ``health.lease_leak`` event (adopted by any HealthMonitor
+        reading the stream, so obs_watch exits nonzero on it) naming
+        the version and its outstanding lease count, and returns
+        False so the caller can decide (alert, force-reap, or keep
+        waiting with an explicit longer timeout)."""
+        if timeout is None:
+            timeout = DEFAULT_RETIRE_WAIT_S
+        if ver._retired_evt.wait(timeout):
+            return True
+        self._obs.event(
+            "health.lease_leak", severity="warn",
+            controller=ver.name, version=ver.version,
+            value=ver.in_flight, threshold=timeout,
+            msg=f"version {ver.name}:{ver.version} still holds "
+                f"{ver.in_flight} lease(s) {timeout:g}s after "
+                "retirement began: a scheduler thread likely died "
+                "mid-batch; the version stays pinned until its leases "
+                "release")
+        return False
 
     # -- artifact loading --------------------------------------------------
 
@@ -248,21 +285,35 @@ class ControllerRegistry:
 
 
 def save_artifacts(tree, roots, dir_path: str,
-                   provenance: Optional[dict] = None) -> None:
+                   provenance: Optional[dict] = None,
+                   checksum: bool = True) -> None:
     """Export a built tree as one serving artifact directory: the
     memmap-streamed leaf table (online/export.write_leaf_table) plus
     the descent arrays as ``descent.npz`` -- exactly what
-    ControllerRegistry.load_artifacts consumes.  RSS stays O(chunk).
+    ControllerRegistry.load_artifacts consumes.  RSS stays O(chunk);
+    ``checksum=False`` skips the per-field sha256 re-read pass for
+    cluster-scale exports (the structural check remains).
     The build-provenance stamp (default: the tree's own) rides the
     table's meta.json so a later deploy or warm rebuild can detect a
-    problem/artifact mismatch."""
+    problem/artifact mismatch.
+
+    Write order is crash-safe: the table fields AND descent.npz land
+    first, the meta.json commit marker LAST (export.commit_leaf_table)
+    -- a crash anywhere mid-export leaves an uncommitted directory,
+    never a 'valid' table next to a missing or stale descent file."""
     from explicit_hybrid_mpc_tpu.online import descent as descent_mod
     from explicit_hybrid_mpc_tpu.online import export as export_mod
 
+    if provenance is None:
+        provenance = getattr(tree, "provenance", None)
     table = export_mod.write_leaf_table(tree, dir_path,
-                                        provenance=provenance)
+                                        provenance=provenance,
+                                        commit=False)
     dt = descent_mod.export_descent(tree, roots, table, stage=False)
     descent_mod.save_descent(dt, os.path.join(dir_path, "descent.npz"))
+    export_mod.commit_leaf_table(dir_path, table.n_leaves, tree.p,
+                                 tree.n_u, provenance,
+                                 checksum=checksum)
 
 
 def root_box(dt) -> tuple[np.ndarray, np.ndarray]:

@@ -52,6 +52,7 @@ import numpy as np
 
 from explicit_hybrid_mpc_tpu import config as config_mod
 from explicit_hybrid_mpc_tpu import obs as obs_lib
+from explicit_hybrid_mpc_tpu.faults import injector as faults_inj
 from explicit_hybrid_mpc_tpu.online import sharded as sharded_mod
 
 #: Rolling window (requests) behind the p99_us / fallback_frac
@@ -308,7 +309,14 @@ class RequestScheduler:
         fill = B / min(sharded_mod._bucket(B), self.max_batch)
         self._fill_roll.append(fill)
         self.n_batches += 1
+        # The lease is a context manager: release runs in its finally,
+        # so ANY raise below -- evaluator error, fallback error, or an
+        # injected serve.batch crash -- drains the ref and a retiring
+        # version can still retire (tests pin this; the wait_retired
+        # timeout + health.lease_leak covers the only remaining leak
+        # mode, a thread killed mid-lease).
         with self.registry.lease(self.controller) as ver:
+            faults_inj.fire("serve.batch", label=self.controller)
             srv = ver.server
             # Heartbeat context for the evaluator's serve.eval event
             # (obs_watch alarms on serving stalls via these fields).

@@ -48,6 +48,26 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 HISTORY = os.path.join(REPO, "BENCH_HISTORY.jsonl")
 
+_ATOMIC = None
+
+
+def _atomic():
+    """The shared crash-safe write utility
+    (explicit_hybrid_mpc_tpu/utils/atomic.py), loaded standalone via
+    importlib: importing it as a package submodule would execute the
+    package __init__ (which imports jax) and turn this light pre-merge
+    gate into a multi-second start."""
+    global _ATOMIC
+    if _ATOMIC is None:
+        import importlib.util
+
+        p = os.path.join(REPO, "explicit_hybrid_mpc_tpu", "utils",
+                         "atomic.py")
+        spec = importlib.util.spec_from_file_location("_ehm_atomic", p)
+        _ATOMIC = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(_ATOMIC)
+    return _ATOMIC
+
 #: metric name -> (direction, default relative tolerance[, absolute
 #: slack]).  Direction "higher" = bigger is better (a drop regresses);
 #: "lower" = smaller is better (a rise regresses).  The optional third
@@ -102,7 +122,7 @@ GATED_METRICS: dict[str, tuple] = {
 }
 
 _ROW_EXTRAS = ("regions", "unit", "precision", "truncated",
-               "device_failures", "uncertified",
+               "device_failures", "quarantined_cells", "uncertified",
                "serve_qps", "serve_batch_fill", "swap_dropped",
                "swap_torn", "ipm_kernel",
                "recert_solves", "subdivision_solves",
@@ -173,8 +193,11 @@ def append_history(bench: dict, source: str, path: str = HISTORY,
     key = (row["source"], row["mtime"])
     if key in seen:
         return None
-    with open(path, "a") as f:
-        f.write(json.dumps(row) + "\n")
+    # Durable append (utils/atomic.py): flush + fsync per row, so the
+    # committed bench trajectory survives the appender dying on the
+    # next line; a crash MID-write tears at most the final line, which
+    # load_history already tolerates.
+    _atomic().append_line_fsync(path, json.dumps(row))
     seen.add(key)
     return row
 

@@ -1,0 +1,277 @@
+"""Pre-merge chaos check: the tier-1 build under scripted fault
+schedules must produce the identical certified tree.
+
+The robustness stack (explicit_hybrid_mpc_tpu/faults/ + the atomic
+checkpoint/artifact writes + scripts/supervise_build.py) claims that a
+faulted build CONVERGES TO THE SAME ANSWER as a clean one.  This
+script makes that claim a gate, next to bench_gate.py and tpulint.py
+in the pre-merge checklist (docs/robustness.md, verify SKILL.md): it
+runs the tier-1 double_integrator flagship config fault-free, then
+under three canned fault schedules, and exits nonzero unless every
+faulted tree is NODE-FOR-NODE IDENTICAL (vertices bitwise, same leaf
+set, same payloads), fully certified, with zero quarantined cells and
+zero hangs:
+
+1. **device-failure**: scripted dispatch + wait failures on the
+   primary oracle mid-build -- recovery via the bit-compatible CPU
+   twin (bounded retries, faults/policy.py).
+2. **solve-timeout**: a scripted 4 s solve hang under
+   ``--solve-timeout 1.5`` -- the watchdog fires, the batch re-solves
+   on the twin.
+3. **kill-mid-checkpoint + supervised resume**: the process
+   ``os._exit``s between checkpoint rotation and the atomic write
+   (the worst-ordered torn checkpoint; only ``.prev`` survives);
+   supervise_build.py restarts it with ``--resume`` and the loader's
+   generation fallback carries it home.
+
+Each schedule runs under a hard subprocess timeout -- a hung child is
+itself a FAILURE (the no-hang half of the acceptance criterion).
+
+Usage::
+
+    python scripts/chaos_suite.py              # full gate (~4-6 min CPU)
+    python scripts/chaos_suite.py --eps 0.5    # quicker smoke
+    python scripts/chaos_suite.py --schedule device_failure
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+#: The tier-1 flagship chaos config: the canonical 392-region
+#: double_integrator build (verify SKILL.md), small enough that four
+#: builds stay a pre-merge-sized check, deep enough that checkpoints,
+#: pipeline lookahead, and the dispatch path are all exercised.
+PROBLEM_ARGS = ["--problem-arg", "N=3", "--problem-arg", "theta_box=1.5"]
+TIMEOUT_S = 900.0
+
+SCHEDULES: dict[str, dict] = {
+    # Dead-device mid-build: dispatch raises on the 2nd primary
+    # program, waits fail twice more later -- under the cap, so the
+    # build recovers per-batch on the twin without degrading.
+    "device_failure": {
+        "faults": [
+            {"site": "oracle.dispatch", "kind": "error", "at": 2,
+             "match": "primary"},
+            {"site": "oracle.wait", "kind": "error", "at": 5},
+        ]},
+    # Wedged solve: the 3rd wait hangs 4 s; --solve-timeout 1.5 cuts
+    # it loose and the twin re-solves the batch.
+    "solve_timeout": {
+        "extra_argv": ["--solve-timeout", "1.5"],
+        "faults": [
+            {"site": "oracle.wait", "kind": "hang", "at": 3,
+             "hang_s": 4.0},
+        ]},
+    # SIGKILL stand-in between checkpoint rotation and the atomic
+    # write (the 2nd checkpoint dies; only .prev survives), then a
+    # supervised restart resumes from the fallback generation.
+    "kill_mid_checkpoint": {
+        "supervised": True,
+        "process_exit": True,
+        "faults": [
+            {"site": "checkpoint.write", "kind": "crash", "at": 2},
+        ]},
+}
+
+
+def _env() -> dict:
+    env = dict(os.environ)
+    # APPEND to PYTHONPATH (never clobber: the TPU plugin loads via
+    # the preset /root/.axon_site entry -- verify SKILL.md gotcha).
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
+def _build_argv(out_prefix: str, eps: float, batch: int) -> list[str]:
+    return ["-e", "double_integrator", "-a", str(eps),
+            "--backend", "cpu", "--batch", str(batch),
+            *PROBLEM_ARGS, "--checkpoint-every", "4",
+            "-o", out_prefix]
+
+
+def run_build(out_prefix: str, eps: float, batch: int,
+              plan_path: str | None = None,
+              extra_argv: list[str] | None = None,
+              supervised: bool = False,
+              timeout_s: float = TIMEOUT_S) -> dict:
+    """One subprocess build; returns {rc, wall_s, hung}."""
+    argv = _build_argv(out_prefix, eps, batch) + (extra_argv or [])
+    if supervised:
+        cmd = [sys.executable, os.path.join(REPO, "scripts",
+                                            "supervise_build.py"),
+               "--max-restarts", "2",
+               "--attempt-timeout", str(timeout_s), "--"] + argv
+    else:
+        cmd = [sys.executable, "-m", "explicit_hybrid_mpc_tpu.main"] \
+            + argv
+    env = _env()
+    if plan_path is not None:
+        env["EHM_FAULT_PLAN"] = plan_path
+    t0 = time.time()
+    try:
+        rc = subprocess.call(cmd, env=env, cwd=REPO,
+                             timeout=timeout_s * (3 if supervised else 1))
+        hung = False
+    except subprocess.TimeoutExpired:
+        rc, hung = -9, True
+    return {"rc": rc, "wall_s": round(time.time() - t0, 1),
+            "hung": hung}
+
+
+def compare_trees(ref_path: str, cand_path: str) -> list[str]:
+    """Node-for-node divergence list ([] = identical): node count,
+    vertex matrices bitwise, converged-leaf set, per-leaf payloads
+    (delta, U, V) bitwise, region count, max depth."""
+    import numpy as np
+
+    from explicit_hybrid_mpc_tpu.partition.tree import Tree
+
+    a, b = Tree.load(ref_path), Tree.load(cand_path)
+    diffs: list[str] = []
+    if len(a) != len(b):
+        return [f"node count {len(a)} != {len(b)}"]
+    if not np.array_equal(a.vertices, b.vertices):
+        diffs.append("vertex matrices differ")
+    ia, ib = a.converged_leaf_ids(), b.converged_leaf_ids()
+    if not np.array_equal(ia, ib):
+        diffs.append(f"converged leaf sets differ "
+                     f"({ia.size} vs {ib.size})")
+        return diffs
+    da, Ua, Va = a.leaf_payloads(ia)
+    db, Ub, Vb = b.leaf_payloads(ib)
+    if not np.array_equal(da, db):
+        diffs.append("leaf commutations differ")
+    if not np.array_equal(Ua, Ub):
+        diffs.append("leaf vertex-input payloads differ")
+    if not np.array_equal(Va, Vb):
+        diffs.append("leaf vertex-cost payloads differ")
+    if a.n_regions() != b.n_regions():
+        diffs.append(f"regions {a.n_regions()} != {b.n_regions()}")
+    if a.max_depth() != b.max_depth():
+        diffs.append(f"max depth {a.max_depth()} != {b.max_depth()}")
+    return diffs
+
+
+def _stats(prefix: str) -> dict:
+    with open(prefix + ".stats.json") as f:
+        return json.load(f)
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--eps", type=float, default=0.2,
+                    help="eps_a for the chaos config (default 0.2 = "
+                         "the 392-region tier-1 flagship; raise for a "
+                         "quicker smoke)")
+    ap.add_argument("--batch", type=int, default=64)
+    ap.add_argument("--schedule", action="append", default=[],
+                    choices=sorted(SCHEDULES),
+                    help="run only these schedules (repeatable; "
+                         "default all)")
+    ap.add_argument("--timeout", type=float, default=TIMEOUT_S,
+                    metavar="S", help="per-build hang budget")
+    ap.add_argument("--workdir", default=None,
+                    help="keep artifacts here instead of a temp dir")
+    ap.add_argument("--json", dest="json_out", default=None,
+                    help="write the structured verdict here")
+    args = ap.parse_args(argv)
+
+    wd = args.workdir or tempfile.mkdtemp(prefix="chaos_suite.")
+    os.makedirs(wd, exist_ok=True)
+    schedules = args.schedule or sorted(SCHEDULES)
+    verdict: dict = {"eps": args.eps, "workdir": wd, "schedules": {}}
+    failures: list[str] = []
+
+    base = os.path.join(wd, "base")
+    print(f"chaos: fault-free reference build (eps {args.eps}) ...",
+          file=sys.stderr)
+    r = run_build(base, args.eps, args.batch, timeout_s=args.timeout)
+    verdict["reference"] = r
+    if r["rc"] != 0 or r["hung"]:
+        print(f"chaos: reference build failed ({r}); nothing to gate",
+              file=sys.stderr)
+        return 2
+    base_stats = _stats(base)
+    if base_stats.get("uncertified", 0) != 0:
+        failures.append(
+            f"reference build is not fully certified "
+            f"({base_stats['uncertified']} uncertified leaves): the "
+            "chaos config must certify cleanly to be a parity anchor")
+
+    for name in schedules:
+        spec = SCHEDULES[name]
+        prefix = os.path.join(wd, name)
+        plan_path = os.path.join(wd, f"{name}.plan.json")
+        with open(plan_path, "w") as f:
+            json.dump({"seed": 7,
+                       "process_exit": spec.get("process_exit", False),
+                       "faults": spec["faults"]}, f, indent=2)
+        print(f"chaos: schedule {name} ...", file=sys.stderr)
+        r = run_build(prefix, args.eps, args.batch,
+                      plan_path=plan_path,
+                      extra_argv=spec.get("extra_argv"),
+                      supervised=spec.get("supervised", False),
+                      timeout_s=args.timeout)
+        row = dict(r)
+        verdict["schedules"][name] = row
+        if r["hung"]:
+            failures.append(f"{name}: build HUNG (> {args.timeout}s)")
+            continue
+        if r["rc"] != 0:
+            failures.append(f"{name}: build exited rc={r['rc']}")
+            continue
+        st = _stats(prefix)
+        row["stats"] = {k: st.get(k) for k in
+                        ("regions", "uncertified", "quarantined_cells",
+                         "device_failures", "device_degraded")}
+        if st.get("quarantined_cells", 0) != 0:
+            failures.append(
+                f"{name}: {st['quarantined_cells']} quarantined "
+                "cell(s) -- an injected fault ESCAPED recovery on the "
+                "acceptance config")
+        if st.get("uncertified", 0) != base_stats.get("uncertified", 0):
+            failures.append(
+                f"{name}: uncertified {st.get('uncertified')} != "
+                f"reference {base_stats.get('uncertified')}")
+        diffs = compare_trees(base + ".tree.pkl", prefix + ".tree.pkl")
+        row["tree_diffs"] = diffs
+        if diffs:
+            failures.append(f"{name}: tree DIVERGED -- "
+                            + "; ".join(diffs))
+        else:
+            print(f"chaos: {name}: tree node-for-node identical "
+                  f"({st['regions']} regions, "
+                  f"{st['device_failures']} device failure(s) "
+                  "recovered)", file=sys.stderr)
+
+    verdict["failures"] = failures
+    if args.json_out:
+        with open(args.json_out, "w") as f:
+            json.dump(verdict, f, indent=2)
+    if not args.workdir:
+        shutil.rmtree(wd, ignore_errors=True)
+    if failures:
+        print("CHAOS SUITE FAILED:", file=sys.stderr)
+        for f_ in failures:
+            print("  " + f_, file=sys.stderr)
+        return 1
+    print(f"CHAOS SUITE OK: {len(schedules)} schedule(s), trees "
+          "node-for-node identical, 0 quarantined, 0 hangs",
+          file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

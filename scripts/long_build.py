@@ -169,7 +169,9 @@ def run(result: dict, out_path: str) -> None:
         oracle = Oracle(problem, **okw)
         result["prune_rows"] = False
     base_wall = 0.0
-    resuming = os.path.exists(ckpt)
+    # A crash between checkpoint rotation and the atomic write leaves
+    # only the .prev generation -- still a resumable campaign.
+    resuming = os.path.exists(ckpt) or os.path.exists(ckpt + ".prev")
     if resuming:
         # Cumulative build wall from the PREVIOUS sessions' artifact:
         # without it a resumed run reports session-local wall against
@@ -205,10 +207,14 @@ def run(result: dict, out_path: str) -> None:
                         base_t=base_wall) as build_obs:
         if resuming:
             log(f"resuming from {ckpt}")
-            import pickle
+            # Verified load with previous-generation fallback: a
+            # campaign killed mid-checkpoint resumes from the newest
+            # generation that passes its content checksum instead of
+            # dying on a torn pickle (docs/robustness.md).
+            from explicit_hybrid_mpc_tpu.partition.frontier import (
+                load_checkpoint)
 
-            with open(ckpt, "rb") as f:
-                snap = pickle.load(f)
+            snap = load_checkpoint(ckpt)
             # HARD compatibility check: a stale checkpoint at the default
             # path combined with changed LONG_* knobs would silently
             # continue a tree certified under DIFFERENT settings.

@@ -146,6 +146,18 @@ def report(records: list[dict]) -> dict:
             reb["reuse_frac"] = out["gauges"]["rebuild.reuse_frac"]
         if reb:
             out["rebuild"] = reb
+        # Robustness ledger (faults/; docs/robustness.md): injected
+        # faults that fired, poison cells quarantined, and the
+        # degraded/lease-leak/quarantine health events -- zero on any
+        # healthy run, so the block renders only when nonzero.
+        flt = {}
+        if out["counters"].get("faults.injected"):
+            flt["injected"] = out["counters"]["faults.injected"]
+        if out["counters"].get("build.quarantined_cells"):
+            flt["quarantined_cells"] = \
+                out["counters"]["build.quarantined_cells"]
+        if flt:
+            out["faults"] = flt
         shards = {}
         for k, v in out["histograms"].items():
             if k.startswith(_SHARD_PREFIX) and k.endswith(".query_s"):
@@ -179,6 +191,22 @@ def report(records: list[dict]) -> dict:
     for r in health:
         warns.append(f"{r['name']} [{r.get('severity')}]: "
                      f"{r.get('msg')}")
+    # Robustness events (faults/): a degraded device or a quarantined
+    # batch is a warning on any capture -- the numbers were produced
+    # on the fallback path.
+    for r in records:
+        if r.get("kind") != "event":
+            continue
+        name = str(r.get("name", ""))
+        if name == "faults.device_degraded":
+            warns.append(
+                f"device DEGRADED after {r.get('failures')} failures: "
+                "the build finished on the CPU fallback oracle")
+        elif name == "faults.quarantine":
+            warns.append(
+                f"quarantined {r.get('cells')} cell(s) on "
+                f"{r.get('query')}: every recovery attempt failed "
+                f"({r.get('error')})")
     n_bundles = out.get("counters", {}).get("recorder.bundles")
     if n_bundles:
         warns.append(f"flight recorder dumped {n_bundles} repro "
@@ -380,6 +408,12 @@ def render_text(rep: dict, flags: list[str], bench_path: str | None) -> str:
             f"{int(reb.get('leaves_reused', 0)) + int(reb.get('leaves_invalidated', 0))}"
             f" prior leaves (reuse_frac {reb.get('reuse_frac', 0.0):.3f}"
             f", {int(reb.get('recert_solves', 0))} recert solves)")
+    flt = rep.get("faults")
+    if flt:
+        ln.append(
+            f"faults: {int(flt.get('injected', 0))} injected, "
+            f"{int(flt.get('quarantined_cells', 0))} cell(s) "
+            "quarantined")
     srv = rep.get("serve")
     if srv:
         ln.append(f"serve: {srv.get('queries')} queries "

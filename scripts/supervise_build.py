@@ -1,0 +1,173 @@
+"""Crash-safe build supervisor: run a build, restart it from the
+latest valid checkpoint until it finishes.
+
+The frontier engine checkpoints and resumes (SURVEY.md section 6.4),
+and PR 12 made both ends crash-safe (atomic checksummed checkpoint
+writes with a ``.prev`` generation; ``load_checkpoint`` falls back).
+What nothing did was CLOSE THE LOOP: a build killed by the OOM killer,
+a device wedge, or a cluster preemption stayed dead until a human
+restarted it.  This script is that loop::
+
+    python scripts/supervise_build.py [supervisor flags] -- \
+        -e inverted_pendulum -a 1e-2 --backend cpu \
+        --checkpoint-every 200 -o artifacts/run
+
+Everything after ``--`` is the ordinary ``explicit_hybrid_mpc_tpu.main``
+build argv.  The supervisor:
+
+1. runs the build as a child process;
+2. on a nonzero exit, looks for the newest valid checkpoint
+   generation (``<output>.ckpt.pkl`` or its ``.prev``) and restarts
+   the child with ``--resume`` pointing at it (the child's
+   load_checkpoint does the integrity check + generation fallback);
+   with no checkpoint on disk it restarts cold;
+3. bounds restarts (``--max-restarts``, default 3) so a
+   deterministically-crashing build cannot flap forever;
+4. writes a summary JSON (restart count, per-attempt exit codes,
+   final rc) next to the build output.
+
+Fault plans: a child inheriting ``EHM_FAULT_PLAN`` replays its
+scripted faults ONCE -- after the first crash the supervisor strips
+the variable from the child environment (``--keep-fault-plan`` opts
+out), because injection counters reset per process and a re-armed
+crash-at-checkpoint-K plan would otherwise kill every restart at the
+same K forever.  scripts/chaos_suite.py drives exactly this flow for
+the kill-mid-checkpoint acceptance schedule.
+
+Equivalence: a supervised faulted build must produce the same tree as
+a straight run -- resumed-equals-straight parity is an engine
+invariant (tests/test_pipeline.py, tests/test_rebuild.py) and
+chaos_suite.py enforces it node-for-node pre-merge.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _build_argv_value(argv: list[str], *names: str) -> str | None:
+    """The value of the first of `names` present in a main.py argv
+    (both ``--flag value`` and ``--flag=value`` spellings)."""
+    for i, a in enumerate(argv):
+        for n in names:
+            if a == n and i + 1 < len(argv):
+                return argv[i + 1]
+            if a.startswith(n + "="):
+                return a.split("=", 1)[1]
+    return None
+
+
+def latest_checkpoint(ckpt: str) -> str | None:
+    """The newest on-disk checkpoint generation, if any.  Validity is
+    the CHILD's job (main.py --resume goes through load_checkpoint,
+    which checksums and falls back); the supervisor only decides
+    between resume and cold restart."""
+    for p in (ckpt, ckpt + ".prev"):
+        if os.path.exists(p):
+            return ckpt  # resume via the primary path: the loader
+            # itself falls back to .prev when the primary is missing
+            # or corrupt.
+    return None
+
+
+def run_supervised(build_argv: list[str], ckpt: str,
+                   max_restarts: int = 3,
+                   keep_fault_plan: bool = False,
+                   attempt_timeout_s: float | None = None,
+                   python: str = sys.executable) -> dict:
+    """Run the build to completion under supervision; returns the
+    summary dict (rc, restarts, attempts)."""
+    env = dict(os.environ)
+    attempts: list[dict] = []
+    rc = -1
+    for attempt in range(max_restarts + 1):
+        argv = list(build_argv)
+        resuming = None
+        if attempt > 0:
+            resuming = latest_checkpoint(ckpt)
+            if resuming and "--resume" not in argv:
+                argv += ["--resume", resuming]
+            if not keep_fault_plan:
+                # Injection counters reset per process: a re-armed
+                # crash plan would kill every restart at the same
+                # site.  The fault happened; recovery runs clean.
+                env.pop("EHM_FAULT_PLAN", None)
+        cmd = [python, "-m", "explicit_hybrid_mpc_tpu.main"] + argv
+        t0 = time.time()
+        try:
+            rc = subprocess.call(cmd, env=env, cwd=REPO,
+                                 timeout=attempt_timeout_s)
+        except subprocess.TimeoutExpired:
+            rc = -9
+            print(f"supervise: attempt {attempt} timed out after "
+                  f"{attempt_timeout_s}s (killed)", file=sys.stderr)
+        attempts.append({"attempt": attempt, "rc": rc,
+                         "resumed_from": resuming,
+                         "wall_s": round(time.time() - t0, 1)})
+        if rc == 0:
+            break
+        print(f"supervise: attempt {attempt} exited rc={rc}; "
+              f"{'restarting' if attempt < max_restarts else 'giving up'}"
+              f" ({max_restarts - attempt} restart(s) left)",
+              file=sys.stderr)
+    return {"rc": rc, "restarts": len(attempts) - 1,
+            "attempts": attempts, "checkpoint": ckpt}
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__.splitlines()[0],
+        usage="supervise_build.py [options] -- <main.py build argv>")
+    ap.add_argument("--max-restarts", type=int, default=3)
+    ap.add_argument("--ckpt", default=None,
+                    help="checkpoint path (default: <output>.ckpt.pkl "
+                         "derived from the build argv's -o)")
+    ap.add_argument("--attempt-timeout", type=float, default=None,
+                    metavar="S",
+                    help="kill an attempt exceeding this wall time "
+                         "(hang insurance; the summary records rc=-9)")
+    ap.add_argument("--keep-fault-plan", action="store_true",
+                    help="keep EHM_FAULT_PLAN in restarted children "
+                         "(default: stripped after the first crash)")
+    ap.add_argument("--summary", default=None,
+                    help="summary JSON path (default: "
+                         "<output>.supervise.json)")
+    if argv is None:
+        argv = sys.argv[1:]
+    if "--" not in argv:
+        ap.error("separate supervisor flags from the build argv "
+                 "with --")
+    split = argv.index("--")
+    args = ap.parse_args(argv[:split])
+    build_argv = argv[split + 1:]
+    if not build_argv:
+        ap.error("empty build argv after --")
+    prefix = _build_argv_value(build_argv, "-o", "--output") or "partition"
+    ckpt = args.ckpt or f"{prefix}.ckpt.pkl"
+    if _build_argv_value(build_argv, "--checkpoint-every") is None \
+            and args.ckpt is None:
+        print("supervise: WARNING -- build argv has no "
+              "--checkpoint-every; a crash restarts from scratch",
+              file=sys.stderr)
+    summary = run_supervised(build_argv, ckpt,
+                             max_restarts=args.max_restarts,
+                             keep_fault_plan=args.keep_fault_plan,
+                             attempt_timeout_s=args.attempt_timeout)
+    out = args.summary or f"{prefix}.supervise.json"
+    with open(out, "w") as f:
+        json.dump(summary, f, indent=2)
+    print(f"supervise: rc={summary['rc']} after "
+          f"{summary['restarts']} restart(s); summary -> {out}",
+          file=sys.stderr)
+    return 0 if summary["rc"] == 0 else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,667 @@
+"""Fault-injection framework + crash-safe supervision (ISSUE 12).
+
+Covers: FaultPlan/FaultSpec parsing + deterministic firing; the
+atomic-write/checksummed-pickle utility; checkpoint generations
+(corrupt-primary -> .prev fallback -> resumed build bit-matches the
+straight-through build); truncated-artifact rejection + the registry
+keeping its previous version; retry/backoff/quarantine around oracle
+solves; the device-failure degrade cap; solve-timeout recovery;
+registry lease-leak detection and publish atomicity under injection;
+the max_quarantine_frac health rule; and the faults obs surface.
+"""
+
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from explicit_hybrid_mpc_tpu import faults
+from explicit_hybrid_mpc_tpu import obs as obs_lib
+from explicit_hybrid_mpc_tpu.config import PartitionConfig
+from explicit_hybrid_mpc_tpu.faults import (FaultPlan, FaultSpec,
+                                            InjectedCrash, InjectedFault)
+from explicit_hybrid_mpc_tpu.partition.frontier import (FrontierEngine,
+                                                        build_partition,
+                                                        load_checkpoint,
+                                                        make_oracle)
+from explicit_hybrid_mpc_tpu.problems.registry import make
+from explicit_hybrid_mpc_tpu.utils import atomic
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_injector():
+    """Every test leaves the process with no installed injector (a
+    leaked plan would fire into unrelated tests' builds)."""
+    yield
+    faults.clear()
+
+
+@pytest.fixture(scope="module")
+def di_problem():
+    return make("double_integrator", N=3, theta_box=1.5)
+
+
+@pytest.fixture(scope="module")
+def pend_problem():
+    return make("inverted_pendulum", N=2)
+
+
+def _cfg(**kw):
+    base = dict(eps_a=0.5, backend="cpu", batch_simplices=32,
+                oracle_retry_backoff_s=0.0)
+    base.update(kw)
+    return PartitionConfig(**base)
+
+
+def _pend_cfg(**kw):
+    return _cfg(problem="inverted_pendulum", max_depth=10, **kw)
+
+
+@pytest.fixture(scope="module")
+def di_clean(di_problem):
+    return build_partition(di_problem, _cfg())
+
+
+@pytest.fixture(scope="module")
+def pend_clean(pend_problem):
+    return build_partition(pend_problem, _pend_cfg())
+
+
+# -- plan / injector -------------------------------------------------------
+
+def test_plan_roundtrip_and_validation(tmp_path):
+    plan = FaultPlan(faults=(
+        {"site": "oracle.call", "kind": "error", "at": 3, "count": 2,
+         "match": "simplex"},
+        {"site": "checkpoint.write", "kind": "crash"},), seed=9,
+        process_exit=True)
+    p = tmp_path / "plan.json"
+    plan.save(str(p))
+    back = FaultPlan.from_json(str(p))
+    assert back == plan
+    with pytest.raises(ValueError, match="unknown fault site"):
+        FaultSpec(site="nope", kind="error")
+    with pytest.raises(ValueError, match="unknown fault kind"):
+        FaultSpec(site="oracle.call", kind="explode")
+    with pytest.raises(ValueError, match="at"):
+        FaultSpec(site="oracle.call", kind="error", at=0)
+    with pytest.raises(ValueError, match="unknown FaultPlan keys"):
+        FaultPlan.from_dict({"surprise": 1})
+
+
+def test_injector_deterministic_firing():
+    plan = FaultPlan(faults=(
+        {"site": "oracle.call", "kind": "error", "at": 2, "count": 2},))
+    with faults.activate(plan) as inj:
+        faults.fire("oracle.call")              # 1: no-op
+        with pytest.raises(InjectedFault):
+            faults.fire("oracle.call")          # 2: fires
+        with pytest.raises(InjectedFault):
+            faults.fire("oracle.call")          # 3: fires (count=2)
+        faults.fire("oracle.call")              # 4: done
+        faults.fire("oracle.wait")              # other site untouched
+    assert inj.n_fired() == 2
+    assert inj.count("oracle.call") == 4
+    # label matching narrows the counter's applicability, not the count
+    plan2 = FaultPlan(faults=(
+        {"site": "oracle.call", "kind": "error", "match": "simplex"},))
+    with faults.activate(plan2) as inj2:
+        faults.fire("oracle.call", label="solve_points")  # no match
+        with pytest.raises(AssertionError):
+            inj2.assert_all_fired()
+
+
+def test_injector_crash_kinds():
+    with faults.activate(FaultPlan(faults=(
+            {"site": "build.step", "kind": "crash"},))):
+        with pytest.raises(InjectedCrash):
+            faults.fire("build.step")
+    # InjectedCrash must NOT be swallowed by device-failure handlers
+    assert not issubclass(InjectedCrash, (RuntimeError, OSError))
+
+
+def test_fire_is_noop_without_plan():
+    faults.clear()
+    faults.fire("oracle.call")  # must not raise
+    assert faults.current() is None
+
+
+# -- atomic utility --------------------------------------------------------
+
+def test_atomic_write_and_checksummed_pickle(tmp_path):
+    p = tmp_path / "obj.pkl"
+    atomic.atomic_pickle(str(p), {"a": 1})
+    obj, checked = atomic.read_checked_pickle(str(p))
+    assert obj == {"a": 1} and checked
+    # legacy (no trailer) loads with checked=False
+    import pickle
+
+    legacy = tmp_path / "legacy.pkl"
+    legacy.write_bytes(pickle.dumps([1, 2]))
+    obj, checked = atomic.read_checked_pickle(str(legacy))
+    assert obj == [1, 2] and not checked
+    # truncation -> CorruptArtifact with a clear message
+    data = p.read_bytes()
+    p.write_bytes(data[:len(data) // 2])
+    with pytest.raises(atomic.CorruptArtifact):
+        atomic.read_checked_pickle(str(p))
+    # bit flip under the checksum -> caught
+    bad = bytearray(data)
+    bad[5] ^= 0x40
+    p.write_bytes(bytes(bad))
+    with pytest.raises(atomic.CorruptArtifact, match="checksum"):
+        atomic.read_checked_pickle(str(p))
+
+
+def test_append_line_fsync(tmp_path):
+    p = tmp_path / "h.jsonl"
+    atomic.append_line_fsync(str(p), json.dumps({"x": 1}))
+    atomic.append_line_fsync(str(p), json.dumps({"x": 2}) + "\n")
+    rows = [json.loads(ln) for ln in p.read_text().splitlines()]
+    assert rows == [{"x": 1}, {"x": 2}]
+
+
+def test_atomic_write_leaves_no_tmp_on_error(tmp_path, monkeypatch):
+    p = tmp_path / "x.bin"
+
+    def boom(src, dst):
+        raise OSError("disk on fire")
+
+    monkeypatch.setattr(os, "replace", boom)
+    with pytest.raises(OSError):
+        atomic.atomic_write_bytes(str(p), b"data")
+    assert list(tmp_path.iterdir()) == []  # tmp file cleaned up
+
+
+# -- checkpoint generations + crash recovery -------------------------------
+
+def test_checkpoint_generation_fallback_and_resume_parity(
+        tmp_path, pend_problem, pend_clean):
+    cfg = _pend_cfg()
+    ck = str(tmp_path / "b.ckpt.pkl")
+    eng = FrontierEngine(pend_problem, make_oracle(pend_problem, cfg),
+                         cfg)
+    for _ in range(3):
+        eng.step()
+    eng.save_checkpoint(ck)
+    for _ in range(2):
+        eng.step()
+    eng.save_checkpoint(ck)          # rotates gen 1 -> .prev
+    assert os.path.exists(ck + ".prev")
+    # SIGKILL-mid-write stand-in: the primary is torn at an arbitrary
+    # byte; the loader must REJECT it and fall back to .prev.
+    with open(ck, "r+b") as f:
+        f.truncate(os.path.getsize(ck) // 2)
+    with pytest.warns(RuntimeWarning, match="previous generation"):
+        snap = load_checkpoint(ck)
+    assert snap["steps"] == 3
+    eng2 = FrontierEngine.resume(snap, pend_problem,
+                                 make_oracle(pend_problem, cfg), cfg=cfg)
+    while eng2.frontier:
+        eng2.step()
+    # The resumed-from-fallback build bit-matches the straight build.
+    assert np.array_equal(pend_clean.tree.vertices, eng2.tree.vertices)
+    assert eng2.n_uncertified == pend_clean.stats["uncertified"]
+
+
+def test_checkpoint_both_generations_dead(tmp_path, pend_problem):
+    cfg = _pend_cfg()
+    ck = str(tmp_path / "b.ckpt.pkl")
+    eng = FrontierEngine(pend_problem, make_oracle(pend_problem, cfg),
+                         cfg)
+    eng.step()
+    eng.save_checkpoint(ck)
+    eng.save_checkpoint(ck)
+    for p in (ck, ck + ".prev"):
+        with open(p, "r+b") as f:
+            f.truncate(16)
+    with pytest.raises(atomic.CorruptArtifact,
+                       match="no valid checkpoint generation"):
+        load_checkpoint(ck)
+
+
+def test_injected_kill_mid_checkpoint_inprocess(tmp_path, pend_problem):
+    """crash between rotation and write: the primary vanishes, .prev
+    carries the previous generation, and the loader recovers."""
+    cfg = _pend_cfg()
+    ck = str(tmp_path / "b.ckpt.pkl")
+    eng = FrontierEngine(pend_problem, make_oracle(pend_problem, cfg),
+                         cfg)
+    eng.step()
+    eng.save_checkpoint(ck)
+    eng.step()
+    with faults.activate(FaultPlan(faults=(
+            {"site": "checkpoint.write", "kind": "crash"},))):
+        with pytest.raises(InjectedCrash):
+            eng.save_checkpoint(ck)
+    assert not os.path.exists(ck) and os.path.exists(ck + ".prev")
+    with pytest.warns(RuntimeWarning, match="previous generation"):
+        snap = load_checkpoint(ck)
+    assert snap["steps"] == 1
+
+
+def test_checkpoint_corrupt_injection_rejected(tmp_path, pend_problem):
+    cfg = _pend_cfg()
+    ck = str(tmp_path / "b.ckpt.pkl")
+    eng = FrontierEngine(pend_problem, make_oracle(pend_problem, cfg),
+                         cfg)
+    eng.step()
+    with faults.activate(FaultPlan(faults=(
+            {"site": "checkpoint.written", "kind": "corrupt",
+             "keep_frac": 0.6},))):
+        eng.save_checkpoint(ck)
+    with pytest.raises(atomic.CorruptArtifact):
+        load_checkpoint(ck, fallback=False)
+
+
+# -- truncated artifacts ---------------------------------------------------
+
+def test_truncated_artifact_rejected_registry_keeps_old(
+        tmp_path, di_clean):
+    from explicit_hybrid_mpc_tpu.serve.registry import (ControllerRegistry,
+                                                        save_artifacts)
+
+    d1 = str(tmp_path / "v1")
+    d2 = str(tmp_path / "v2")
+    save_artifacts(di_clean.tree, di_clean.roots, d1)
+    save_artifacts(di_clean.tree, di_clean.roots, d2)
+    reg = ControllerRegistry()
+    v1 = reg.load_artifacts("ctl", "v1", d1)
+    # Torn second-generation artifact: truncate a field file.
+    with open(os.path.join(d2, "bary_M.npy"), "r+b") as f:
+        f.truncate(os.path.getsize(os.path.join(d2, "bary_M.npy")) // 3)
+    with pytest.raises(atomic.CorruptArtifact):
+        reg.load_artifacts("ctl", "v2", d2)
+    # The registry still serves the previous valid generation.
+    assert reg.active_version("ctl") == "v1"
+    with reg.lease("ctl") as ver:
+        assert ver is v1
+
+
+def test_artifact_checksum_verify(tmp_path, di_clean):
+    from explicit_hybrid_mpc_tpu.online import export
+
+    d = str(tmp_path / "t")
+    export.write_leaf_table(di_clean.tree, d)
+    export.load_leaf_table(d, verify_checksum=True)  # clean passes
+    # Flip a payload byte INSIDE the array data: shape stays valid, so
+    # only the checksum can catch it.
+    p = os.path.join(d, "V.npy")
+    with open(p, "r+b") as f:
+        f.seek(os.path.getsize(p) - 3)
+        b = f.read(1)
+        f.seek(os.path.getsize(p) - 3)
+        f.write(bytes([b[0] ^ 1]))
+    with pytest.raises(atomic.CorruptArtifact, match="sha256"):
+        export.load_leaf_table(d, verify_checksum=True)
+
+
+def test_artifact_meta_commit_marker_mismatch(tmp_path, di_clean):
+    from explicit_hybrid_mpc_tpu.online import export
+
+    d = str(tmp_path / "t")
+    export.write_leaf_table(di_clean.tree, d)
+    meta_p = os.path.join(d, "meta.json")
+    with open(meta_p) as f:
+        meta = json.load(f)
+    meta["n_leaves"] += 5  # stale commit marker vs arrays
+    meta.pop("checksums", None)
+    with open(meta_p, "w") as f:
+        json.dump(meta, f)
+    with pytest.raises(atomic.CorruptArtifact, match="meta.json"):
+        export.load_leaf_table(d)
+
+
+def test_corrupt_injection_on_artifact_written(tmp_path, di_clean):
+    from explicit_hybrid_mpc_tpu.online import export
+
+    d = str(tmp_path / "t")
+    with faults.activate(FaultPlan(faults=(
+            {"site": "artifact.written", "kind": "corrupt",
+             "keep_frac": 0.4},))) as inj:
+        export.write_leaf_table(di_clean.tree, d)
+    assert inj.n_fired() == 1
+    with pytest.raises(atomic.CorruptArtifact):
+        export.load_leaf_table(d)
+
+
+def test_save_artifacts_commit_marker_ordering(tmp_path, di_clean,
+                                               monkeypatch):
+    """A crash between the leaf-table export and the descent write
+    must leave an UNCOMMITTED directory (no meta.json) -- never a
+    'valid' table next to a missing/stale descent.npz."""
+    from explicit_hybrid_mpc_tpu.online import descent as descent_mod
+    from explicit_hybrid_mpc_tpu.serve.registry import (ControllerRegistry,
+                                                        save_artifacts)
+
+    d = str(tmp_path / "v")
+
+    def boom(table, path):
+        raise InjectedCrash("crash before descent landed")
+
+    monkeypatch.setattr(descent_mod, "save_descent", boom)
+    with pytest.raises(InjectedCrash):
+        save_artifacts(di_clean.tree, di_clean.roots, d)
+    assert not os.path.exists(os.path.join(d, "meta.json"))
+    monkeypatch.undo()
+    # Re-export into the SAME directory completes and loads cleanly
+    # (the torn attempt left no stale commit marker to confuse it).
+    save_artifacts(di_clean.tree, di_clean.roots, d)
+    reg = ControllerRegistry()
+    assert reg.load_artifacts("ctl", "v1", d).version == "v1"
+
+
+def test_rebuild_rejects_corrupt_prior(tmp_path, di_clean, di_problem):
+    from explicit_hybrid_mpc_tpu.partition.rebuild import (RebuildError,
+                                                           warm_rebuild)
+
+    p = str(tmp_path / "prior.tree.pkl")
+    di_clean.tree.save(p)
+    with open(p, "r+b") as f:
+        f.truncate(os.path.getsize(p) // 2)
+    with pytest.raises(RebuildError, match="integrity"):
+        warm_rebuild(di_problem, _cfg(), p)
+
+
+# -- retry / quarantine / degrade ------------------------------------------
+
+def test_device_failure_recovery_tree_parity(di_problem, di_clean):
+    plan = FaultPlan(faults=(
+        {"site": "oracle.dispatch", "kind": "error", "at": 2,
+         "match": "primary"},
+        {"site": "oracle.wait", "kind": "error", "at": 4},))
+    with faults.activate(plan) as inj:
+        res = build_partition(di_problem, _cfg())
+    inj.assert_all_fired()
+    assert res.stats["device_failures"] == 2
+    assert res.stats["quarantined_cells"] == 0
+    assert np.array_equal(di_clean.tree.vertices, res.tree.vertices)
+
+
+def test_solve_hang_timeout_recovery(di_problem, di_clean):
+    plan = FaultPlan(faults=(
+        {"site": "oracle.wait", "kind": "hang", "at": 2,
+         "hang_s": 5.0},))
+    with faults.activate(plan) as inj:
+        res = build_partition(di_problem, _cfg(solve_timeout_s=0.5))
+    inj.assert_all_fired()
+    assert res.stats["device_failures"] == 1
+    assert res.stats["quarantined_cells"] == 0
+    assert np.array_equal(di_clean.tree.vertices, res.tree.vertices)
+
+
+def test_quarantine_on_exhausted_recovery(pend_problem):
+    """Primary AND fallback scripted dead for one stage-2 call: the
+    cells quarantine, the build survives, and the result is sound
+    (only extra splitting / uncertified closures)."""
+    plan = FaultPlan(faults=(
+        {"site": "oracle.call", "kind": "error", "at": 1},
+        {"site": "oracle.fallback", "kind": "error", "at": 1,
+         "count": 2},))
+    cfg = _pend_cfg(oracle_retry_attempts=2, obs="jsonl")
+    with faults.activate(plan) as inj:
+        res = build_partition(pend_problem, cfg)
+    inj.assert_all_fired()
+    assert res.stats["quarantined_cells"] > 0
+    assert not res.stats["truncated"]  # the build went to completion
+
+
+def test_quarantine_emits_obs_counter(pend_problem):
+    obs = obs_lib.Obs("jsonl")
+    plan = FaultPlan(faults=(
+        {"site": "oracle.call", "kind": "error", "at": 1},
+        {"site": "oracle.fallback", "kind": "error", "at": 1,
+         "count": 2},))
+    with faults.activate(plan):
+        res = build_partition(
+            pend_problem, _pend_cfg(oracle_retry_attempts=2), obs=obs)
+    snap = obs.flush_metrics()
+    assert snap["counters"]["build.quarantined_cells"] \
+        == res.stats["quarantined_cells"]
+    assert snap["counters"]["faults.injected"] >= 2
+    names = [r.get("name") for r in obs.sink.records]
+    assert "faults.quarantine" in names and "faults.injected" in names
+
+
+def test_device_degrade_cap(pend_problem, pend_clean):
+    """A persistently failing device degrades the engine ONCE (cap +
+    in-flight stragglers), not per-batch, and the twin finishes the
+    identical tree."""
+    plan = FaultPlan(faults=(
+        {"site": "oracle.dispatch", "kind": "error", "at": 1,
+         "count": 100000, "match": "primary"},))
+    with faults.activate(plan):
+        res = build_partition(
+            pend_problem, _pend_cfg(device_failure_cap=3))
+    assert res.stats["device_degraded"]
+    # Bounded by cap + the handles already in flight at degrade time
+    # -- nowhere near one failure per batch.
+    assert 3 <= res.stats["device_failures"] <= 3 + 5
+    assert res.stats["quarantined_cells"] == 0
+    assert np.array_equal(pend_clean.tree.vertices, res.tree.vertices)
+
+
+def test_retry_policy_validation():
+    from explicit_hybrid_mpc_tpu.faults import RetryPolicy
+
+    with pytest.raises(ValueError):
+        RetryPolicy(max_attempts=0)
+    with pytest.raises(ValueError):
+        RetryPolicy(solve_timeout_s=0)
+    with pytest.raises(ValueError):
+        PartitionConfig(eps_a=0.1, oracle_retry_attempts=0)
+    with pytest.raises(ValueError):
+        PartitionConfig(eps_a=0.1, solve_timeout_s=-1)
+    assert RetryPolicy(backoff_s=0.1).backoff(2) == pytest.approx(0.4)
+
+
+# -- serve: lease leak + publish atomicity ---------------------------------
+
+def _dummy_server(di_clean, tmp_path, name):
+    from explicit_hybrid_mpc_tpu.serve.registry import save_artifacts
+
+    d = str(tmp_path / name)
+    save_artifacts(di_clean.tree, di_clean.roots, d)
+    return d
+
+
+def test_wait_retired_timeout_emits_lease_leak(tmp_path, di_clean):
+    from explicit_hybrid_mpc_tpu.serve.registry import ControllerRegistry
+
+    obs = obs_lib.Obs("jsonl")
+    reg = ControllerRegistry(obs=obs)
+    d = _dummy_server(di_clean, tmp_path, "v1")
+    v1 = reg.load_artifacts("ctl", "v1", d)
+    # A thread that died holding a lease: enter without exiting.
+    leak = reg.lease("ctl")
+    leak.__enter__()
+    reg.load_artifacts("ctl", "v2", d)     # v1 -> retiring, pinned
+    assert not reg.wait_retired(v1, timeout=0.05)
+    ev = [r for r in obs.sink.records
+          if r.get("name") == "health.lease_leak"]
+    assert ev and ev[-1]["value"] == 1 and ev[-1]["severity"] == "warn"
+    # HealthMonitor adopts the event -> external watchers exit nonzero.
+    from explicit_hybrid_mpc_tpu.obs.health import HealthMonitor
+
+    mon = HealthMonitor()
+    mon.feed(ev[-1])
+    assert mon.worst == "warn"
+    leak.__exit__(None, None, None)
+    assert reg.wait_retired(v1, timeout=1.0)
+
+
+def test_publish_injection_leaves_registry_intact(tmp_path, di_clean):
+    from explicit_hybrid_mpc_tpu.serve.registry import ControllerRegistry
+
+    reg = ControllerRegistry()
+    d = _dummy_server(di_clean, tmp_path, "v1")
+    reg.load_artifacts("ctl", "v1", d)
+    with faults.activate(FaultPlan(faults=(
+            {"site": "registry.publish", "kind": "error"},))):
+        with pytest.raises(InjectedFault):
+            reg.load_artifacts("ctl", "v2", d)
+    assert reg.active_version("ctl") == "v1"
+    with reg.lease("ctl") as ver:
+        assert ver.version == "v1"
+
+
+def test_scheduler_crash_mid_batch_releases_lease(tmp_path, di_clean):
+    """An injected serve.batch crash inside the leased evaluation
+    fails the tickets but NEVER pins the version (lease released in
+    the context manager's finally)."""
+    from explicit_hybrid_mpc_tpu.serve.registry import ControllerRegistry
+    from explicit_hybrid_mpc_tpu.serve.scheduler import RequestScheduler
+
+    reg = ControllerRegistry()
+    d = _dummy_server(di_clean, tmp_path, "v1")
+    v1 = reg.load_artifacts("ctl", "v1", d)
+    sched = RequestScheduler(reg, "ctl", max_batch=8, max_wait_us=500.0)
+    with faults.activate(FaultPlan(faults=(
+            {"site": "serve.batch", "kind": "crash"},))):
+        t = sched.submit(np.zeros(v1.server.root_bary.shape[-1] - 1))
+        with pytest.raises(InjectedCrash):
+            t.result(timeout=5.0)
+    reg.load_artifacts("ctl", "v2", d)
+    assert reg.wait_retired(v1, timeout=5.0)  # v1 drained, not pinned
+    sched.close()
+
+
+# -- health rule + sink durability -----------------------------------------
+
+def test_max_quarantine_frac_rule():
+    from explicit_hybrid_mpc_tpu.obs.health import HealthMonitor
+
+    mon = HealthMonitor({"max_quarantine_frac": 0.01,
+                         "min_solves_for_rates": 100})
+    ev = mon.feed({"kind": "metrics",
+                   "counters": {"build.quarantined_cells": 50,
+                                "oracle.point_solves": 1000,
+                                "oracle.simplex_solves": 0},
+                   "gauges": {}})
+    assert [e["name"] for e in ev] == ["health.quarantine"]
+    assert mon.worst == "critical"
+    # volume gate: tiny runs never trip it
+    mon2 = HealthMonitor({"max_quarantine_frac": 0.01,
+                          "min_solves_for_rates": 2000})
+    assert not mon2.feed({"kind": "metrics",
+                          "counters": {"build.quarantined_cells": 5,
+                                       "oracle.point_solves": 50},
+                          "gauges": {}})
+    # 0 disables
+    mon3 = HealthMonitor({"max_quarantine_frac": 0,
+                          "min_solves_for_rates": 10})
+    assert not mon3.feed({"kind": "metrics",
+                          "counters": {"build.quarantined_cells": 500,
+                                       "oracle.point_solves": 100},
+                          "gauges": {}})
+
+
+def test_sink_fsync_every(tmp_path):
+    from explicit_hybrid_mpc_tpu.obs.sink import JsonlSink, load_jsonl
+
+    p = str(tmp_path / "s.jsonl")
+    with JsonlSink(p, fsync_every=2) as s:
+        for i in range(5):
+            s.emit("event", "e", i=i)
+    assert len(load_jsonl(p)) == 5
+
+
+def test_obs_report_renders_faults_block(tmp_path, pend_problem):
+    import importlib.util
+    import sys as _sys
+
+    spec = importlib.util.spec_from_file_location(
+        "obs_report", os.path.join(os.path.dirname(__file__), "..",
+                                   "scripts", "obs_report.py"))
+    obs_report = importlib.util.module_from_spec(spec)
+    _sys.modules["obs_report"] = spec.loader.exec_module(obs_report) \
+        or obs_report
+    path = str(tmp_path / "s.obs.jsonl")
+    obs = obs_lib.Obs("jsonl", path=path)
+    plan = FaultPlan(faults=(
+        {"site": "oracle.call", "kind": "error", "at": 1},
+        {"site": "oracle.fallback", "kind": "error", "at": 1,
+         "count": 2},))
+    with faults.activate(plan):
+        build_partition(pend_problem,
+                        _pend_cfg(oracle_retry_attempts=2), obs=obs)
+    obs.flush_metrics()
+    obs.close(snapshot=False)
+    rep = obs_report.report(obs_report.load_jsonl(path))
+    assert rep["faults"]["quarantined_cells"] > 0
+    assert rep["faults"]["injected"] >= 2
+    text = obs_report.render_text(rep, [], None)
+    assert "faults:" in text and "quarantined" in text
+    assert any("quarantined" in w for w in rep.get("warnings", []))
+
+
+def test_bench_gate_append_history_durable(tmp_path):
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "bench_gate", os.path.join(os.path.dirname(__file__), "..",
+                                   "scripts", "bench_gate.py"))
+    bench_gate = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench_gate)
+    hist = str(tmp_path / "H.jsonl")
+    row = bench_gate.append_history(
+        {"value": 1.0, "platform": "cpu", "metric": "r/s",
+         "quarantined_cells": 0}, "BENCH_x.json", path=hist, mtime=1.0)
+    assert row is not None and row["quarantined_cells"] == 0
+    assert bench_gate.load_history(hist)[0]["value"] == 1.0
+    # dupe key skipped
+    assert bench_gate.append_history(
+        {"value": 1.0, "platform": "cpu"}, "BENCH_x.json", path=hist,
+        mtime=1.0) is None
+
+
+def test_tree_save_checksummed_load_rejects_corrupt(tmp_path, di_clean):
+    from explicit_hybrid_mpc_tpu.partition.tree import Tree
+
+    p = str(tmp_path / "t.tree.pkl")
+    di_clean.tree.save(p)
+    t2 = Tree.load(p)
+    assert np.array_equal(di_clean.tree.vertices, t2.vertices)
+    with open(p, "r+b") as f:
+        f.truncate(os.path.getsize(p) - 7)
+    with pytest.raises(atomic.CorruptArtifact):
+        Tree.load(p)
+
+
+def test_config_fault_plan_threading(tmp_path, di_problem, di_clean):
+    """cfg.fault_plan (a path) installs the injector inside
+    build_partition -- the CLI/EHM_FAULT_PLAN surface, minus the
+    subprocess."""
+    plan_p = str(tmp_path / "plan.json")
+    FaultPlan(faults=(
+        {"site": "oracle.wait", "kind": "error", "at": 1},)).save(plan_p)
+    try:
+        res = build_partition(di_problem, _cfg(fault_plan=plan_p))
+    finally:
+        faults.clear()
+    assert res.stats["device_failures"] == 1
+    assert np.array_equal(di_clean.tree.vertices, res.tree.vertices)
+
+
+def test_concurrent_fire_thread_safety():
+    plan = FaultPlan(faults=(
+        {"site": "serve.batch", "kind": "error", "at": 500},))
+    with faults.activate(plan) as inj:
+        errs = []
+
+        def worker():
+            for _ in range(100):
+                try:
+                    faults.fire("serve.batch")
+                except InjectedFault as e:
+                    errs.append(e)
+
+        ts = [threading.Thread(target=worker) for _ in range(8)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert inj.count("serve.batch") == 800
+        assert len(errs) == 1  # exactly the scripted occurrence

@@ -240,8 +240,11 @@ def test_checkpoint_carries_stamp(di_problem, di_cfg, tmp_path):
     cfg = dataclasses.replace(di_cfg, checkpoint_every=2,
                               checkpoint_path=ckpt, max_steps=4)
     build_partition(di_problem, cfg)
-    with open(ckpt, "rb") as f:
-        snap = pickle.load(f)
+    # Checkpoints carry the PR-12 content-checksum header: read through
+    # the verifying loader, not bare pickle.load.
+    from explicit_hybrid_mpc_tpu.partition.frontier import load_checkpoint
+
+    snap = load_checkpoint(ckpt)
     assert snap["provenance"]["problem_hash"] == \
         prov.problem_hash(di_problem)
     assert snap["tree"].provenance is not None

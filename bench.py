@@ -711,6 +711,12 @@ def run(result: dict, monitor: ContentionMonitor | None = None) -> None:
                       getattr(oracle, "warmstart_accept_rate", 0.0), 4),
                   compiled_shapes=len(
                       getattr(oracle, "compiled_shapes", ())))
+    # Per-step critical-path attribution (fleet telemetry, ISSUE 13):
+    # run-mean fraction of step wall per segment -- the occupancy
+    # decomposition behind device_frac (docs/observability.md).
+    for seg in ("fill", "plan", "wait", "certify", "other"):
+        result[f"cp_{seg}_frac"] = stats.get(f"cp_{seg}_frac")
+    result["cp_checkpoint_s"] = stats.get("cp_checkpoint_s")
 
     # -- serial-oracle baseline estimate -----------------------------------
     # Point QPs and joint simplex QPs are structurally different sizes:
@@ -1141,6 +1147,16 @@ def main(argv: list[str] | None = None) -> int:
     # globals inside functions): the jax-importing package loads only
     # here, inside the guard.
     monitor = _contention_monitor_cls()()
+    # Fleet-telemetry join keys (obs/clock.py): the capture row carries
+    # the process run_id and the obs schema version it wrote, so a
+    # BENCH_HISTORY.jsonl entry is joinable back to the obs streams of
+    # the run that produced it (bench_gate._ROW_EXTRAS lifts both).
+    from explicit_hybrid_mpc_tpu.obs import clock as _obs_clock
+    from explicit_hybrid_mpc_tpu.obs.sink import (
+        SCHEMA_VERSION as _obs_schema_version)
+
+    result["run_id"] = _obs_clock.run_id()
+    result["obs_schema_version"] = _obs_schema_version
     try:
         if rebuild_mode:
             run_rebuild(result, monitor)

@@ -229,6 +229,24 @@ class PartitionConfig:
     # legacy flat per-step RunLog stream, kept for existing consumers).
     obs: str = "off"
     obs_path: Optional[str] = None
+    # Per-process obs streams (obs/fleet.py): suffix obs_path with
+    # .p<process_index>-<pid>, so N processes sharing one configured
+    # path (supervised restart chains, multi-process pjit builds)
+    # write N separate streams instead of interleaving one file -- a
+    # crashed writer's torn line mid-file would make load_jsonl reject
+    # the whole stream.  Readers resolve the bare name transparently;
+    # obs_report/obs_watch --fleet merge the family.
+    obs_per_process: bool = False
+    # Health-triggered bounded device profiling (obs/profiling.py
+    # AutoProfiler): the first CRITICAL in-build health verdict
+    # (stall, quarantine storm, ...; needs cfg.health_rules + obs on)
+    # opens a jax.profiler capture bounded to profile_steps frontier
+    # steps and drops a summarized auto_profile JSON bundle next to
+    # the recorder's -- a sick long build self-captures the evidence
+    # instead of burning the allocation.  At most one capture per run;
+    # ignored while cfg.profile_path runs a manual trace (jax allows
+    # one active trace).
+    auto_profile: bool = False
     # Flight recorder (obs/recorder.py): when True, solver anomalies --
     # cells still feasible-but-unconverged after the two-phase cohort
     # and the rescue pass, simplex rows with no usable bound, device-

@@ -141,6 +141,20 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--obs-path", metavar="FILE", default=None,
                    help="override the obs stream path "
                         "(default PREFIX.obs.jsonl)")
+    p.add_argument("--obs-per-process", action="store_true",
+                   help="suffix the obs stream with .p<index>-<pid> "
+                        "(fleet telemetry): N processes sharing one "
+                        "prefix -- supervised restarts, multi-process "
+                        "builds -- write N streams instead of "
+                        "interleaving one file; merge with "
+                        "obs_report/obs_watch --fleet")
+    p.add_argument("--auto-profile", action="store_true",
+                   help="health-triggered bounded device profiling "
+                        "(obs/profiling.py): the first CRITICAL "
+                        "in-build health verdict opens a jax.profiler "
+                        "capture of --profile-steps steps and drops a "
+                        "summarized auto_profile JSON bundle (needs "
+                        "--obs and --health-rule)")
     p.add_argument("--recorder", action="store_true",
                    help="flight recorder: dump versioned compressed "
                         "repro bundles on solver anomalies (diverged "
@@ -316,6 +330,8 @@ def main(argv: list[str] | None = None) -> int:
         obs=args.obs,
         obs_path=(args.obs_path or f"{prefix}.obs.jsonl"
                   if args.obs != "off" else None),
+        obs_per_process=args.obs_per_process,
+        auto_profile=args.auto_profile,
         # --recorder-dir implies --recorder: naming a bundle directory
         # and silently recording nothing would be the worst reading.
         obs_recorder=args.recorder or bool(args.recorder_dir),
@@ -400,6 +416,8 @@ def main(argv: list[str] | None = None) -> int:
             profile_path=cfg.profile_path,
             profile_steps=cfg.profile_steps,
             obs=cfg.obs, obs_path=cfg.obs_path,
+            obs_per_process=cfg.obs_per_process,
+            auto_profile=cfg.auto_profile,
             # Diagnostics knobs are output-class too: recording repro
             # bundles or watching health changes nothing about the
             # solve, so THIS run's flags win over the snapshot's.

@@ -79,13 +79,24 @@ class Obs:
     """The unified observability handle (see module docstring)."""
 
     def __init__(self, mode: str = "off", path: Optional[str] = None,
-                 echo: bool = False, base_t: float = 0.0):
+                 echo: bool = False, base_t: float = 0.0,
+                 per_process: bool = False):
+        """per_process: suffix `path` with ``.pI-PID`` (obs/fleet.py)
+        so N processes sharing one configured stream path -- a
+        supervised restart chain, a multi-process pjit build, co-host
+        serve replicas -- never interleave one file; readers resolve
+        the bare name (sink.load_jsonl) and fleet tooling
+        (obs_report/obs_watch --fleet) merges the family."""
         if mode not in MODES:
             raise ValueError(f"unknown obs mode {mode!r} "
                              f"(expected one of {MODES})")
         self.mode = mode
         self.enabled = mode != "off"
         if self.enabled:
+            if path and per_process:
+                from explicit_hybrid_mpc_tpu.obs import fleet
+
+                path = fleet.per_process_path(path)
             self.sink = JsonlSink(path, echo=echo, base_t=base_t,
                                   schema_meta=True)
             self.metrics = MetricsRegistry()
@@ -156,7 +167,8 @@ def from_config(cfg) -> Obs:
     mode = getattr(cfg, "obs", "off") or "off"
     if mode == "off":
         return NOOP
-    return Obs(mode, path=getattr(cfg, "obs_path", None))
+    return Obs(mode, path=getattr(cfg, "obs_path", None),
+               per_process=getattr(cfg, "obs_per_process", False))
 
 
 _default: Obs = NOOP

@@ -69,6 +69,18 @@ Rule schema (all values floats; 0 disables a threshold rule):
                            build is surviving by GIVING UP on cells
                            at scale -- solver infrastructure is
                            broken, not one poison cell
+``max_shard_straggle_frac``  FLEET rule (obs/fleet.py FleetMonitor;
+                           scripts/obs_watch.py --fleet): concurrent
+                           shards' regions/s spread, 1 - slowest /
+                           fastest -> ``health.shard_straggle`` (warn)
+                           -- faster shards idle on the straggler's
+                           work every step.  Single-stream monitors
+                           never evaluate it.
+``fleet_stall``            FLEET rule: EVERY shard's stream silent
+                           for this many wall seconds ->
+                           ``health.fleet_stall`` (critical); a single
+                           silent shard still fires the per-stream
+                           ``stall_s`` rule with the shard named
 ``min_solves_for_rates``   rate rules stay silent below this volume
 ``metrics_every_steps``    engine-side feed cadence (frontier.py)
 =========================  =============================================
@@ -106,6 +118,11 @@ DEFAULT_RULES: dict[str, float] = {
     "min_rebuild_reuse": 0.2,
     "min_rebuild_leaves": 500.0,
     "max_quarantine_frac": 0.02,
+    # Fleet-level rules (obs/fleet.py FleetMonitor; single-stream
+    # monitors carry but never evaluate them, so one validated rule
+    # vocabulary covers obs_watch with and without --fleet).
+    "max_shard_straggle_frac": 0.5,
+    "fleet_stall": 300.0,
     "min_solves_for_rates": 2000.0,
     "metrics_every_steps": 100.0,
 }

@@ -1,0 +1,193 @@
+"""Device-profile capture + Chrome-trace summarization.
+
+Two consumers share this module:
+
+- ``scripts/profile_capture.py`` (the manual capture driver) imports
+  ``summarize_trace`` -- factored here so the summarizer is library
+  code, importable by the auto-capture path and the tests, instead of
+  living inside a script.
+- ``AutoProfiler``: **health-triggered** bounded capture.  A sick long
+  build (stall, quarantine storm, straggler) used to burn the rest of
+  its allocation producing nothing an engineer could act on -- the
+  evidence (what the device was doing while the build was sick) only
+  exists if someone was already running ``--profile``.  With
+  ``cfg.auto_profile`` (CLI ``--auto-profile``, long_build
+  ``LONG_AUTO_PROFILE``) the frontier engine arms an AutoProfiler;
+  the first CRITICAL in-build health verdict opens a
+  ``jax.profiler`` trace bounded to ``profile_steps`` frontier steps
+  (and a hard wall ceiling), then writes a summarized
+  ``auto_profile.json`` bundle next to the flight recorder's repro
+  bundles.  At most ``max_captures`` (default 1) per run: a capture
+  is expensive and the first one carries the evidence; storms must
+  not fill the disk with traces.  Raw traces go to a scratch dir
+  (tens of MB); the committed evidence is the summary JSON, exactly
+  like the manual capture script.
+"""
+
+from __future__ import annotations
+
+import glob
+import gzip
+import json
+import os
+import time
+from typing import Optional
+
+
+def summarize_trace(trace_dir: str, top_n: int = 25) -> dict:
+    """Top ops by summed duration from the Chrome-trace JSON(.gz) files
+    jax.profiler writes under <dir>/plugins/profile/<run>/.  (Moved
+    from scripts/profile_capture.py; that script now imports it.)"""
+    paths = (glob.glob(os.path.join(trace_dir, "**", "*.trace.json.gz"),
+                       recursive=True)
+             + glob.glob(os.path.join(trace_dir, "**", "*.trace.json"),
+                         recursive=True))
+    if not paths:
+        return {"error": f"no trace files under {trace_dir}"}
+    by_name: dict[str, float] = {}
+    pid_names: dict[int, str] = {}
+    total_events = 0
+    for path in paths:
+        opener = gzip.open if path.endswith(".gz") else open
+        with opener(path, "rt") as f:
+            data = json.load(f)
+        for ev in data.get("traceEvents", []):
+            if ev.get("ph") == "M" and ev.get("name") == "process_name":
+                pid_names[ev.get("pid")] = ev["args"].get("name", "")
+            if ev.get("ph") != "X" or "dur" not in ev:
+                continue
+            total_events += 1
+            name = ev.get("name", "?")[:120]
+            by_name[name] = by_name.get(name, 0.0) + ev["dur"]
+    top = sorted(by_name.items(), key=lambda kv: -kv[1])[:top_n]
+    return {
+        "trace_files": len(paths),
+        "events": total_events,
+        "tracks": sorted(set(pid_names.values())),
+        "top_ops_ms": [{"name": n, "total_ms": round(d / 1e3, 3)}
+                       for n, d in top],
+    }
+
+
+class AutoProfiler:
+    """Bounded, health-triggered jax.profiler capture (module docs).
+
+    Driven by the frontier engine: ``trigger(reason)`` opens a capture
+    (no-op while one is open or after ``max_captures``);
+    ``on_step(obs)`` advances/closes it (called at the end of every
+    frontier step); ``finish(obs)`` closes a capture the run ended
+    inside.  All device interaction is guarded -- a profiler that
+    cannot start (another trace active, backend quirk) records the
+    error in the bundle instead of taking the build down: capture is
+    diagnostics, never load-bearing."""
+
+    def __init__(self, out_dir: str, steps: int = 5,
+                 max_captures: int = 1, max_wall_s: float = 120.0,
+                 trace_dir: Optional[str] = None):
+        self.out_dir = out_dir
+        self.steps = max(1, int(steps))
+        self.max_captures = max(1, int(max_captures))
+        self.max_wall_s = float(max_wall_s)
+        self.trace_dir = trace_dir or os.path.join(
+            out_dir, "auto_profile_trace")
+        self.n_captures = 0
+        self.bundles: list[str] = []
+        self._active = False
+        self._steps_left = 0
+        self._t_start = 0.0
+        self._reason: Optional[dict] = None
+
+    @property
+    def active(self) -> bool:
+        return self._active
+
+    def trigger(self, reason: str, detail: Optional[dict] = None,
+                obs=None, step: Optional[int] = None) -> bool:
+        """Open a capture for `reason`; returns True when one started."""
+        if self._active or self.n_captures >= self.max_captures:
+            return False
+        try:
+            os.makedirs(self.trace_dir, exist_ok=True)
+            import jax
+
+            jax.profiler.start_trace(self.trace_dir)
+        except Exception as e:  # diagnostics must never kill the build
+            # (full disk, unwritable dir, profiler already active, ...)
+            self.n_captures += 1  # burn the budget: retrying won't help
+            self._write_bundle({"reason": reason, "detail": detail,
+                                "step": step,
+                                "error": f"start_trace failed: {e!r}"},
+                               obs)
+            return False
+        self.n_captures += 1
+        self._active = True
+        self._steps_left = self.steps
+        self._t_start = time.perf_counter()
+        self._reason = {"reason": reason, "detail": detail, "step": step}
+        if obs is not None:
+            obs.event("profile.capture_start", reason=reason, step=step,
+                      trace_dir=self.trace_dir, steps=self.steps)
+        return True
+
+    def on_step(self, obs=None) -> Optional[str]:
+        """Advance an open capture one frontier step; closes it (and
+        returns the bundle path) once the step budget or the wall
+        ceiling is spent."""
+        if not self._active:
+            return None
+        self._steps_left -= 1
+        if self._steps_left > 0 \
+                and time.perf_counter() - self._t_start < self.max_wall_s:
+            return None
+        return self._stop(obs)
+
+    def finish(self, obs=None) -> Optional[str]:
+        """Close a capture the run ended inside (frontier drained or
+        halted mid-window)."""
+        if not self._active:
+            return None
+        return self._stop(obs)
+
+    def _stop(self, obs) -> Optional[str]:
+        self._active = False
+        try:
+            import jax
+
+            jax.profiler.stop_trace()
+        except Exception as e:
+            return self._write_bundle(
+                {**(self._reason or {}),
+                 "error": f"stop_trace failed: {e!r}"}, obs)
+        meta = dict(self._reason or {})
+        meta["captured_steps"] = self.steps - max(0, self._steps_left)
+        meta["capture_wall_s"] = round(
+            time.perf_counter() - self._t_start, 3)
+        meta["trace_dir"] = self.trace_dir
+        try:
+            meta["trace_summary"] = summarize_trace(self.trace_dir)
+        except Exception as e:  # corrupt trace file etc.
+            meta["error"] = f"summarize failed: {e!r}"
+        return self._write_bundle(meta, obs)
+
+    def _write_bundle(self, meta: dict, obs) -> Optional[str]:
+        """Best-effort bundle write: a full disk at capture-close time
+        must not take the build down with it (the event record still
+        carries the error so the failure is visible in the stream)."""
+        path = None
+        try:
+            os.makedirs(self.out_dir, exist_ok=True)
+            n = len(self.bundles) + 1
+            path = os.path.join(self.out_dir,
+                                f"auto_profile_{n:03d}.json")
+            with open(path, "w") as f:
+                json.dump(meta, f, indent=2)
+            self.bundles.append(path)
+        except Exception as e:
+            meta = {**meta, "error": f"bundle write failed: {e!r}"}
+            path = None
+        if obs is not None:
+            obs.event("profile.capture", path=path,
+                      reason=meta.get("reason"),
+                      error=meta.get("error"))
+            obs.counter("build.auto_profiles").inc()
+        return path

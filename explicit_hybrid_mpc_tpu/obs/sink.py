@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import atexit
 import json
+import os
 import sys
 import threading
 import time
@@ -23,7 +24,13 @@ import numpy as np
 # Bumped whenever the record envelope or a producer's field layout
 # changes incompatibly; the sink stamps it into the stream's leading
 # `meta`/`schema` record and readers (scripts/obs_report.py) check it.
-SCHEMA_VERSION = 1
+# v2 (fleet telemetry, ISSUE 13): every stream's second record is a
+# `meta`/`stream` IDENTITY record -- run_id, host, pid, process
+# index/count, and the wall-vs-monotonic clock anchor (obs/clock.py)
+# that lets obs/fleet.py merge N per-process streams onto one time
+# axis.  v1 streams (no identity record) still load everywhere;
+# fleet-level readers treat them as anchor-less legacy shards.
+SCHEMA_VERSION = 2
 
 
 def json_default(o):
@@ -93,6 +100,12 @@ class JsonlSink:
             atexit.register(self.close)
         if schema_meta:
             self.emit("meta", "schema", version=SCHEMA_VERSION)
+            # Stream identity + clock anchor (schema v2, obs/clock.py):
+            # the record's own `t` with its wall_time field is the
+            # monotonic-vs-wall anchor fleet merging aligns on.
+            from explicit_hybrid_mpc_tpu.obs import clock
+
+            self.emit("meta", "stream", **clock.identity())
 
     def _unregister_atexit(self) -> None:
         try:
@@ -159,7 +172,28 @@ def load_jsonl(path: str, tolerant_tail: bool = True) -> list[dict]:
     of the stream stays readable -- the crashed run is exactly when the
     stream matters most.  Corruption anywhere EARLIER still raises: a
     mangled middle means the file itself is damaged, not merely cut
-    short."""
+    short.
+
+    Bare-name resolution (fleet telemetry satellite): a per-process
+    writer (``Obs(per_process=True)`` / ``cfg.obs_per_process``)
+    suffixes the configured path with ``.pI-PID``, so the OLD bare
+    name a reader was handed may not exist.  When exactly one suffixed
+    sibling does, it is read transparently; several siblings raise a
+    clear error naming the fleet readers instead of silently picking
+    one shard's stream."""
+    if not os.path.exists(path):
+        from explicit_hybrid_mpc_tpu.obs import fleet
+
+        sibs = fleet.sibling_streams(path)
+        if len(sibs) == 1:
+            path = sibs[0]
+        elif sibs:
+            raise FileNotFoundError(
+                f"{path} does not exist but {len(sibs)} per-process "
+                f"streams do ({', '.join(os.path.basename(s) for s in sibs[:4])}"
+                f"{', ...' if len(sibs) > 4 else ''}): merge them with "
+                "obs_report --fleet / obs.fleet.load_fleet instead of "
+                "reading one shard")
     recs: list[dict] = []
     bad_at = None
     with open(path) as f:

@@ -44,6 +44,25 @@ def init_distributed(coordinator_address: Optional[str] = None,
     return jax.process_index()
 
 
+def process_coords() -> dict:
+    """This process's shard coordinates for stream identity (fleet
+    telemetry, docs/observability.md): process index/count plus its
+    addressable-device slice of the global device list.  Backend-
+    initializing by design -- call it from drivers that are past
+    ``init_distributed``; the obs sink's identity record uses the
+    init-free probe in obs/clock.py instead (a sink must never be the
+    thing that first touches a dead TPU tunnel)."""
+    out = {"process_index": int(jax.process_index()),
+           "process_count": int(jax.process_count()),
+           "n_local_devices": int(jax.local_device_count())}
+    try:
+        out["local_device_ids"] = [int(d.id)
+                                   for d in jax.local_devices()]
+    except Exception:  # tpulint: disable=silent-except -- identity is best-effort
+        pass
+    return out
+
+
 def is_frontier_owner() -> bool:
     """True on the process that owns checkpoint/output writing (process 0
     -- the reference's scheduler rank).  NOTE the frontier STATE runs on

@@ -323,6 +323,24 @@ class FrontierEngine:
         # (session-local, like n_device_failures).
         self.n_quarantined_cells = 0
         self._degraded = False
+        # Per-step critical-path ledger (fleet telemetry, ISSUE 13):
+        # cumulative wall seconds per step segment -- pipeline fill,
+        # authoritative host planning, device wait/dispatch, host
+        # certify+commit, residual -- plus checkpoint wall (outside
+        # the step loop).  Per-step figures ride the build.step event
+        # (cp_*_s fields, fractions of step_s summing to 1 by
+        # construction); cumulative fractions ride the build.cp_*_frac
+        # gauges, stats_dict, and the bench row.
+        self._cp = {"fill": 0.0, "plan": 0.0, "wait": 0.0,
+                    "certify": 0.0, "other": 0.0, "checkpoint": 0.0}
+        self._cp_step_s = 0.0  # cumulative step wall (fraction denom)
+        # Wall time the previous step ended (None before the first):
+        # the in-build stall probe measures the gap at the next step's
+        # start, so a wedged solve that eventually recovers (injected
+        # hang, device wedge) still registers as a stall with the
+        # health monitor -- the auto-profile trigger.  Updated after
+        # checkpoints too (a slow checkpoint is not a stall).
+        self._last_step_end: float | None = None
         self.recorder = None
         # recorder_dir implies obs_recorder at EVERY entry point (the
         # CLI applies the same rule): naming a bundle directory while
@@ -349,6 +367,25 @@ class FrontierEngine:
 
             self._health = HealthMonitor(rules_from_pairs(rules),
                                          sink=self.obs.sink)
+        # Health-triggered bounded device profiling (cfg.auto_profile;
+        # obs/profiling.py): armed here, triggered by the first
+        # critical health verdict (_poll_auto_profile) or an external
+        # driver (trigger_auto_profile -- long_build's halt path).
+        # Mutually exclusive with a manual cfg.profile_path trace: jax
+        # allows one active trace, and the manual capture IS the
+        # evidence the auto-capture exists to produce.
+        self._auto_prof = None
+        if getattr(self.cfg, "auto_profile", False) \
+                and not self.cfg.profile_path:
+            from explicit_hybrid_mpc_tpu.obs.profiling import AutoProfiler
+
+            out_dir = (getattr(self.cfg, "recorder_dir", None)
+                       or (os.path.dirname(
+                           getattr(self.cfg, "obs_path", None) or "")
+                           or "artifacts"))
+            self._auto_prof = AutoProfiler(
+                out_dir, steps=self.cfg.profile_steps)
+        self._auto_prof_seen_events = 0
         # Runtime recompile sentinel (cfg.recompile_guard): armed after
         # the first _GUARD_WARMUP_FULL_STEPS full-size batches, checked
         # on every later full-size step -- see _guard_step.
@@ -1103,6 +1140,11 @@ class FrontierEngine:
         # and the device never idles during host-side certification.
         with self.obs.span("build.pipeline_fill"):
             pipe.fill()
+        # Critical-path segment boundaries (ISSUE 13): fill wall is
+        # measured by the pipeline itself; everything the oracle
+        # charges to _oracle_s from here on is device wait/dispatch.
+        t_fill_end = time.perf_counter()
+        oracle_s_fill = self._oracle_s
         # Authoritative plan, computed against exactly the cache state
         # the synchronous build would see at this step; the pipeline
         # serves route-matched cells from the in-flight window (one
@@ -1111,6 +1153,7 @@ class FrontierEngine:
         # rows -- node-for-node identical to the synchronous build
         # (partition/pipeline.py, correctness model).
         plan = self._plan_missing(nodes)
+        t_plan_end = time.perf_counter()
         if plan is not None:
             sol, pair_out = pipe.serve(plan)
             self._merge_plan_results(plan, sol, pair_out)
@@ -1370,8 +1413,43 @@ class FrontierEngine:
             # cache (mis-speculation = waste, never a changed tree).
             pipe.on_commit(n, split=did_split)
 
+        t_work_end = time.perf_counter()
+        # In-build wall-stall probe: how long since the previous
+        # step's records went out -- the silent window an external
+        # obs_watch tail would have measured on the stream.  A wedged-
+        # then-recovered solve (device hang, injected fault) shows up
+        # HERE, not in the inter-step gap: the step that contained it
+        # ran longer than the stall budget with nothing emitted.
+        # Checked before this step's own records are emitted, so the
+        # health.stall event lands in the stream at the position the
+        # silence ended -- and the auto-profile trigger riding on a
+        # critical verdict (cfg.auto_profile) fires without an
+        # external watcher.
+        if self._health is not None and self._last_step_end is not None:
+            self._health.check_stall(t_work_end - self._last_step_end)
         self.steps += 1
-        step_s = time.perf_counter() - t_step
+        step_s = t_work_end - t_step
+        # Per-step critical-path wall breakdown (fleet telemetry):
+        # fill (lookahead plan+dispatch, pipeline-measured), plan (the
+        # authoritative re-plan), wait (everything the oracle layer
+        # charged to _oracle_s after fill -- blocking waits, residual
+        # dispatches, stage-2 calls, speculation dispatch), certify
+        # (the remaining host wall of the gather/certify/commit
+        # block), other (prologue + the residual; clamped at 0 against
+        # timer noise).  The five sum to step_s by construction, so
+        # the per-step fractions sum to 1.
+        cp_fill = min(pipe.last_fill_wall, t_fill_end - t_step)
+        cp_plan = t_plan_end - t_fill_end
+        cp_wait = self._oracle_s - oracle_s_fill
+        cp_certify = max(0.0, (t_work_end - t_plan_end) - cp_wait)
+        cp_other = max(0.0, step_s - cp_fill - cp_plan - cp_wait
+                       - cp_certify)
+        self._cp["fill"] += cp_fill
+        self._cp["plan"] += cp_plan
+        self._cp["wait"] += cp_wait
+        self._cp["certify"] += cp_certify
+        self._cp["other"] += cp_other
+        self._cp_step_s += step_s
         regions = self.tree.n_regions()
         # Fraction of the step spent blocked on oracle device programs
         # -- the JSONL device-utilization proxy (SURVEY.md section 6.5;
@@ -1423,12 +1501,27 @@ class FrontierEngine:
             m.gauge("build.spec_hit_rate").set(pipe.spec_hit_rate())
             m.gauge("build.spec_waste_frac").set(
                 pipe.spec_waste_frac(self.oracle.n_point_solves))
+            # Cumulative critical-path attribution: seconds per
+            # segment plus run-mean fractions of step wall (the
+            # occupancy decomposition obs_report renders and the
+            # bench row records; docs/observability.md "Fleet
+            # telemetry").
+            denom = max(self._cp_step_s, 1e-9)
+            for seg in ("fill", "plan", "wait", "certify", "other"):
+                m.gauge(f"build.cp_{seg}_s").set(self._cp[seg])
+                m.gauge(f"build.cp_{seg}_frac").set(
+                    self._cp[seg] / denom)
             rec = o.event("build.step", step=self.steps, regions=regions,
                           frontier=len(self.frontier), batch=B,
                           leaves=n_leaves, splits=n_splits,
                           step_s=round(step_s, 6),
                           device_frac=device_frac,
-                          pipeline=pipe.in_flight)
+                          pipeline=pipe.in_flight,
+                          cp_fill_s=round(cp_fill, 6),
+                          cp_plan_s=round(cp_plan, 6),
+                          cp_wait_s=round(cp_wait, 6),
+                          cp_certify_s=round(cp_certify, 6),
+                          cp_other_s=round(cp_other, 6))
             if self._health is not None:
                 # In-stream watchdog (cfg.health_rules): rolling rules
                 # over the step events, plus a periodic metrics
@@ -1442,6 +1535,43 @@ class FrontierEngine:
                     self._health.feed(o.flush_metrics())
         if self._rc_guard is not None:
             self._guard_step(B)
+        if self._auto_prof is not None:
+            self._poll_auto_profile()
+        self._last_step_end = time.perf_counter()
+
+    def _poll_auto_profile(self) -> None:
+        """Advance an open auto-capture one step; open one when the
+        in-build health verdict turned CRITICAL since the last poll
+        (obs/profiling.py AutoProfiler; cfg.auto_profile)."""
+        ap = self._auto_prof
+        ap.on_step(self.obs)
+        if self._health is None or ap.active \
+                or ap.n_captures >= ap.max_captures:
+            return
+        evs = self._health.events
+        while self._auto_prof_seen_events < len(evs):
+            ev = evs[self._auto_prof_seen_events]
+            self._auto_prof_seen_events += 1
+            if ev.get("severity") == "critical":
+                ap.trigger(ev.get("name", "critical"),
+                           detail={"msg": ev.get("msg"),
+                                   "value": ev.get("value"),
+                                   "threshold": ev.get("threshold")},
+                           obs=self.obs, step=self.steps)
+                break
+
+    def trigger_auto_profile(self, reason: str) -> int:
+        """External capture trigger (scripts/long_build.py's
+        health-halt path: capture the evidence BEFORE halting).
+        Returns how many more frontier steps the caller should run to
+        fill the capture window; 0 when auto-profiling is not armed,
+        already capturing, or the per-run budget is spent."""
+        ap = self._auto_prof
+        if ap is None:
+            return 0
+        if ap.trigger(reason, obs=self.obs, step=self.steps):
+            return ap.steps
+        return 0
 
     # -- full run ----------------------------------------------------------
 
@@ -1501,6 +1631,10 @@ class FrontierEngine:
         build still ships its histograms -- the snapshot matters MOST
         for the run that died; external step-loop drivers (long_build)
         own their handle's lifecycle and close it themselves."""
+        if self._auto_prof is not None:
+            # Close a capture the run ended inside (frontier drained
+            # or halted mid-window); the summary bundle still lands.
+            self._auto_prof.finish(self.obs)
         if self.obs.enabled:
             self.obs.flush_metrics()
             if self._owns_obs:
@@ -1575,6 +1709,10 @@ class FrontierEngine:
                 self._pipe.spec_waste_frac(self.oracle.n_point_solves),
                 4),
             "device_failures": self.n_device_failures,
+            # Checkpoint wall (the one critical-path segment outside
+            # the step loop); the per-segment step-wall fractions are
+            # appended below when any step ran.
+            "cp_checkpoint_s": round(self._cp["checkpoint"], 3),
             # Poison-cell quarantine (faults/policy.py): cells whose
             # every recovery attempt failed and that were closed with
             # synthesized no-information results.  0 on any healthy
@@ -1586,11 +1724,29 @@ class FrontierEngine:
             "cache_peak_mb": round(self.cache.peak_bytes / 2**20, 2),
             "cache_live_vertices": len(self.cache),
         }
+        # Critical-path attribution (ISSUE 13): run-mean fraction of
+        # step wall per segment -- they sum to ~1 by construction (the
+        # per-step residual is clamped at 0 against timer noise).
+        # bench.py lifts these into the capture row.
+        if self._cp_step_s > 0:
+            for seg in ("fill", "plan", "wait", "certify", "other"):
+                stats[f"cp_{seg}_frac"] = round(
+                    self._cp[seg] / self._cp_step_s, 4)
         return stats
 
     # -- checkpoint / resume (SURVEY.md section 6.4) -----------------------
 
     def save_checkpoint(self, path: str) -> None:
+        t_ck = time.perf_counter()
+        try:
+            self._save_checkpoint(path, t_ck)
+        finally:
+            # A slow checkpoint is not a stall: re-arm the in-build
+            # wall-stall probe so the next step's gap measures real
+            # silence, not the serialization we just did on purpose.
+            self._last_step_end = time.perf_counter()
+
+    def _save_checkpoint(self, path: str, t_ck: float) -> None:
         # Cancel the in-flight pipeline BEFORE serializing (and before
         # the owner check -- under SPMD every process must cancel
         # identically to stay in lockstep): a snapshot is only ever
@@ -1655,6 +1811,21 @@ class FrontierEngine:
         # the generation fallback exists for (chaos schedule 3).
         faults_lib.fire("checkpoint.write", label=os.path.basename(path))
         atomic.atomic_pickle(path, snap)
+        # Checkpoint wall into the critical-path ledger, then a
+        # metrics snapshot into the stream BEFORE the crash-injection
+        # site below: the snapshot is the per-process stream's "work
+        # completed through this checkpoint" record, which is what
+        # makes fleet counter rollups over a supervised restart chain
+        # reconcile EXACTLY (a process os._exit-killed at the
+        # checkpoint boundary has already shipped its totals;
+        # obs/fleet.py, scripts/fleet_smoke.py).  Doubles as the
+        # resumable counter/histogram trajectory long_build used to
+        # flush itself.
+        self._cp["checkpoint"] += time.perf_counter() - t_ck
+        if self.obs.enabled:
+            self.obs.gauge("build.cp_checkpoint_s").set(
+                self._cp["checkpoint"])
+            self.obs.flush_metrics()
         # At-rest corruption site: `corrupt` kinds mangle the landed
         # file so the loader's checksum rejection is exercised.
         faults_lib.fire("checkpoint.written",

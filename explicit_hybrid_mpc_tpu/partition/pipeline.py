@@ -172,6 +172,11 @@ class BuildPipeline:
         self.spec_dropped_unwaited = 0
         self._fill_sum = 0.0
         self._fill_steps = 0
+        # Wall seconds of the most recent fill() call -- the
+        # "pipeline fill" segment of the engine's per-step critical-
+        # path breakdown (frontier.step; measured here so lookahead
+        # planning + dispatch cost is attributed by its owner).
+        self.last_fill_wall = 0.0
 
     # -- stats -------------------------------------------------------------
 
@@ -215,7 +220,9 @@ class BuildPipeline:
         a full prefix of the deque is exactly what the synchronous loop
         would pop (children append at the back)."""
         if self.depth == 0:
+            self.last_fill_wall = 0.0
             return
+        t_fill = time.perf_counter()
         eng = self.eng
         B = eng.cfg.batch_simplices
         while len(self._claims) < self.depth:
@@ -242,6 +249,7 @@ class BuildPipeline:
         # pipeline_fill_frac bench gate exists to catch.
         self._fill_sum += self.planned_in_flight / self.depth
         self._fill_steps += 1
+        self.last_fill_wall = time.perf_counter() - t_fill
 
     def pop_claim(self, nodes: list[int]) -> bool:
         """Consume the head claim if it matches this step's batch.  A

@@ -43,6 +43,8 @@ batch_fill_frac so obs_watch can alarm on serving stalls.
 from __future__ import annotations
 
 import dataclasses
+import os
+import socket
 import threading
 import time
 from collections import deque
@@ -197,6 +199,18 @@ class RequestScheduler:
                 "requests_all": m.counter("serve.requests"),
                 "batches_all": m.counter("serve.batches"),
             }
+            # Per-replica identity (fleet telemetry): a replicated
+            # serving fleet runs one scheduler per controller per
+            # process, and the merged view must attribute each
+            # serve.ctl.* metric family to a concrete replica.  The
+            # stream's own meta/stream record carries host/pid; this
+            # event binds the CONTROLLER name to that identity.
+            from explicit_hybrid_mpc_tpu.obs import clock
+
+            self._obs.event("serve.replica", controller=controller,
+                            run_id=clock.run_id(),
+                            host=socket.gethostname(),
+                            pid=os.getpid())
         self._worker = threading.Thread(
             target=self._loop, name=f"serve-{controller}", daemon=True)
         self._worker.start()

@@ -127,7 +127,16 @@ _ROW_EXTRAS = ("regions", "unit", "precision", "truncated",
                "swap_torn", "ipm_kernel",
                "recert_solves", "subdivision_solves",
                "rebuild_invalidated", "rebuild_cold_wall_s",
-               "rebuild_wall_s")
+               "rebuild_wall_s",
+               # Fleet telemetry (ISSUE 13): run_id + the obs schema
+               # version the capture wrote make a history row joinable
+               # back to its obs streams; the cp_* fractions are the
+               # per-step critical-path decomposition (informational
+               # extras, not gated -- their healthy values are
+               # workload-shaped, not monotone).
+               "run_id", "obs_schema_version",
+               "cp_fill_frac", "cp_plan_frac", "cp_wait_frac",
+               "cp_certify_frac", "cp_other_frac", "cp_checkpoint_s")
 
 
 def summarize(bench: dict, source: str, mtime: float | None = None) -> dict:

@@ -1,0 +1,232 @@
+"""Pre-merge fleet-telemetry smoke: per-process obs streams of a
+supervised 2-process build must reconcile EXACTLY with the
+single-process build's totals.
+
+The fleet aggregation layer (obs/fleet.py; docs/observability.md
+"Fleet telemetry") claims that summing N per-process streams' final
+metrics snapshots reproduces what one process would have recorded.
+This script makes that claim a gate, next to chaos_suite.py in the
+pre-merge checklist (verify SKILL.md):
+
+1. **Reference**: the tier-1 double_integrator flagship config builds
+   single-process with ``--obs jsonl --obs-per-process`` -- one
+   suffixed stream whose final snapshot holds the ground-truth
+   counters.
+2. **Fleet**: the same build runs under scripts/supervise_build.py
+   with an injected ``os._exit`` at the 2nd ``checkpoint.written``
+   site -- the checkpoint is fully on disk, the process dies at the
+   boundary, and the supervisor resumes a SECOND process from it.
+   Two processes => two per-process streams, each ending in a metrics
+   snapshot (the engine flushes one per checkpoint, before the
+   injection site, exactly so a boundary kill ships its totals).
+3. **Reconcile**: ``obs_report --fleet`` over the two streams must
+   exit 0 under ``--strict`` (schema v2 + identity everywhere, one
+   shared run_id courtesy of the supervisor's EHM_RUN_ID), and the
+   rollup's summed counters must EQUAL the reference stream's --
+   bit-exactly for the integer counters -- while the trees match
+   node-for-node (the chaos-suite comparator).
+
+A crash at the checkpoint BOUNDARY is the one restart shape with zero
+replayed work (the resumed session re-executes nothing), which is
+what makes exact counter equality the right assertion; mid-interval
+crashes re-execute the steps since the checkpoint and their streams
+legitimately over-count -- the aggregator reports what ran, not what
+the tree kept.
+
+Usage::
+
+    python scripts/fleet_smoke.py              # full gate (~2-3 min CPU)
+    python scripts/fleet_smoke.py --eps 0.5    # quicker smoke
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+PROBLEM_ARGS = ["--problem-arg", "N=3", "--problem-arg", "theta_box=1.5"]
+TIMEOUT_S = 900.0
+
+#: Counters whose fleet-rollup sum must equal the reference stream's
+#: value exactly (all integers; every one counts work the session
+#: itself executed, so a zero-replay restart chain partitions them).
+RECONCILED_COUNTERS = (
+    "build.steps", "build.leaves", "build.splits",
+    "build.oracle_solves", "oracle.point_solves",
+    "oracle.simplex_solves",
+)
+
+
+def _env(plan_path: str | None = None) -> dict:
+    env = dict(os.environ)
+    # APPEND to PYTHONPATH (never clobber -- verify SKILL.md gotcha).
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    if plan_path is not None:
+        env["EHM_FAULT_PLAN"] = plan_path
+    return env
+
+
+def _build_argv(out_prefix: str, eps: float, batch: int) -> list[str]:
+    return ["-e", "double_integrator", "-a", str(eps),
+            "--backend", "cpu", "--batch", str(batch),
+            *PROBLEM_ARGS, "--checkpoint-every", "4",
+            "--obs", "jsonl", "--obs-per-process",
+            "-o", out_prefix]
+
+
+def run_build(out_prefix: str, eps: float, batch: int,
+              plan_path: str | None = None, supervised: bool = False,
+              timeout_s: float = TIMEOUT_S) -> dict:
+    argv = _build_argv(out_prefix, eps, batch)
+    if supervised:
+        cmd = [sys.executable,
+               os.path.join(REPO, "scripts", "supervise_build.py"),
+               "--max-restarts", "2",
+               "--attempt-timeout", str(timeout_s), "--"] + argv
+    else:
+        cmd = [sys.executable, "-m", "explicit_hybrid_mpc_tpu.main"] \
+            + argv
+    t0 = time.time()
+    try:
+        rc = subprocess.call(cmd, env=_env(plan_path), cwd=REPO,
+                             timeout=timeout_s * (3 if supervised else 1))
+        hung = False
+    except subprocess.TimeoutExpired:
+        rc, hung = -9, True
+    return {"rc": rc, "wall_s": round(time.time() - t0, 1), "hung": hung}
+
+
+def _stream_counters(prefix: str) -> tuple[dict, list]:
+    """(final-snapshot counters, streams) for one build prefix's
+    per-process obs stream family."""
+    from explicit_hybrid_mpc_tpu.obs import fleet as fleet_lib
+
+    streams = fleet_lib.load_fleet(prefix + ".obs.jsonl")
+    roll = fleet_lib.fleet_rollup(streams)
+    return roll["counters"], streams
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--eps", type=float, default=0.2,
+                    help="eps_a (default 0.2 = the 392-region tier-1 "
+                         "flagship; raise for a quicker smoke)")
+    ap.add_argument("--batch", type=int, default=64)
+    ap.add_argument("--timeout", type=float, default=TIMEOUT_S)
+    ap.add_argument("--workdir", default=None,
+                    help="keep artifacts here instead of a temp dir")
+    ap.add_argument("--json", dest="json_out", default=None)
+    args = ap.parse_args(argv)
+
+    wd = args.workdir or tempfile.mkdtemp(prefix="fleet_smoke.")
+    os.makedirs(wd, exist_ok=True)
+    verdict: dict = {"eps": args.eps, "workdir": wd}
+    failures: list[str] = []
+
+    ref = os.path.join(wd, "straight")
+    print(f"fleet_smoke: single-process reference build "
+          f"(eps {args.eps}) ...", file=sys.stderr)
+    r = run_build(ref, args.eps, args.batch, timeout_s=args.timeout)
+    verdict["reference"] = r
+    if r["rc"] != 0 or r["hung"]:
+        print(f"fleet_smoke: reference build failed ({r})",
+              file=sys.stderr)
+        return 2
+
+    flt = os.path.join(wd, "fleet")
+    plan_path = os.path.join(wd, "plan.json")
+    with open(plan_path, "w") as f:
+        # Die at the 2nd checkpoint BOUNDARY (fully-written file, no
+        # replay on resume -- see module docstring).
+        json.dump({"seed": 7, "process_exit": True,
+                   "faults": [{"site": "checkpoint.written",
+                               "kind": "crash", "at": 2}]}, f)
+    print("fleet_smoke: supervised 2-process build "
+          "(crash at checkpoint 2) ...", file=sys.stderr)
+    r = run_build(flt, args.eps, args.batch, plan_path=plan_path,
+                  supervised=True, timeout_s=args.timeout)
+    verdict["fleet"] = r
+    if r["rc"] != 0 or r["hung"]:
+        print(f"fleet_smoke: supervised build failed ({r})",
+              file=sys.stderr)
+        return 2
+
+    # -- reconcile ---------------------------------------------------------
+    ref_counters, ref_streams = _stream_counters(ref)
+    flt_counters, flt_streams = _stream_counters(flt)
+    verdict["n_fleet_streams"] = len(flt_streams)
+    if len(flt_streams) != 2:
+        failures.append(
+            f"expected 2 per-process streams from the supervised run, "
+            f"got {len(flt_streams)} "
+            f"({[os.path.basename(s.path) for s in flt_streams]})")
+    run_ids = {s.identity.get("run_id") for s in flt_streams
+               if s.identity}
+    if len(run_ids) != 1:
+        failures.append(f"fleet streams carry {len(run_ids)} run_ids "
+                        f"({sorted(run_ids)}); the supervisor's "
+                        "EHM_RUN_ID should unify the chain")
+    recon = {}
+    for key in RECONCILED_COUNTERS:
+        a, b = ref_counters.get(key), flt_counters.get(key)
+        recon[key] = {"reference": a, "fleet_sum": b}
+        if a != b:
+            failures.append(f"counter {key}: fleet sum {b} != "
+                            f"single-process {a}")
+    verdict["reconciliation"] = recon
+
+    with open(ref + ".stats.json") as f:
+        ref_stats = json.load(f)
+    with open(flt + ".stats.json") as f:
+        flt_stats = json.load(f)
+    if ref_stats["regions"] != flt_stats["regions"]:
+        failures.append(f"regions {flt_stats['regions']} != reference "
+                        f"{ref_stats['regions']}")
+    from chaos_suite import compare_trees
+
+    diffs = compare_trees(ref + ".tree.pkl", flt + ".tree.pkl")
+    verdict["tree_diffs"] = diffs
+    if diffs:
+        failures.append("tree DIVERGED -- " + "; ".join(diffs))
+
+    # obs_report --fleet --strict must render + pass (schema v2,
+    # identity present, one run_id).
+    rep_json = os.path.join(wd, "fleet_report.json")
+    rc = subprocess.call(
+        [sys.executable, os.path.join(REPO, "scripts", "obs_report.py"),
+         flt + ".obs.p*.jsonl", "--fleet", "--strict",
+         "--json", rep_json], env=_env(), cwd=REPO)
+    if rc != 0:
+        failures.append(f"obs_report --fleet --strict exited {rc}")
+
+    verdict["failures"] = failures
+    if args.json_out:
+        with open(args.json_out, "w") as f:
+            json.dump(verdict, f, indent=2)
+    if not args.workdir:
+        shutil.rmtree(wd, ignore_errors=True)
+    if failures:
+        print("FLEET SMOKE FAILED:", file=sys.stderr)
+        for f_ in failures:
+            print("  " + f_, file=sys.stderr)
+        return 1
+    print(f"FLEET SMOKE OK: {len(flt_streams)} streams reconcile "
+          f"exactly with the single-process build "
+          f"({ref_stats['regions']} regions, "
+          f"{len(RECONCILED_COUNTERS)} counters bit-equal, tree "
+          "node-for-node identical)", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -45,6 +45,14 @@ into the obs stream, where the in-build HealthMonitor folds it into
 the campaign verdict and an external ``scripts/obs_watch.py`` tail
 exits nonzero on it.  An external terminal can additionally follow the
 live stream: ``python scripts/obs_watch.py <artifact>.obs.jsonl``.
+
+Fleet telemetry (ISSUE 13): LONG_AUTO_PROFILE (default 1) -- on a
+critical health verdict the campaign opens a bounded jax.profiler
+capture and drops a summarized ``auto_profile_*.json`` bundle next to
+the recorder's before checkpoint-and-halting (obs/profiling.py);
+LONG_OBS_PER_PROCESS=1 -- each resumed session writes its own
+``.pI-PID`` obs stream instead of appending to one file, merged by
+``scripts/obs_report.py --fleet``.
 """
 
 from __future__ import annotations
@@ -155,6 +163,18 @@ def run(result: dict, out_path: str) -> None:
         # only to a post-hoc profile.
         recompile_guard=_rc_guard_mode(
             os.environ.get("LONG_RECOMPILE_GUARD", "warn")),
+        # Health-triggered bounded device profiling (obs/profiling.py;
+        # LONG_AUTO_PROFILE=0 disables): when the checkpoint-cadence
+        # health watchdog below goes critical, the campaign captures a
+        # bounded jax.profiler window BEFORE checkpoint-and-halting --
+        # the evidence of what the device was doing while the build
+        # was sick, instead of just the corpse.
+        auto_profile=os.environ.get("LONG_AUTO_PROFILE", "1") != "0",
+        # Per-process obs streams (LONG_OBS_PER_PROCESS=1): each
+        # resumed session writes its own .pI-PID stream instead of
+        # appending to one file; obs_report --fleet merges the chain.
+        obs_per_process=os.environ.get("LONG_OBS_PER_PROCESS",
+                                       "0") != "0",
         log_path=out_path.replace(".json", ".log.jsonl"))
     okw = dict(backend="device" if platform != "cpu" else "cpu",
                precision=precision, **sched_kw)
@@ -203,8 +223,8 @@ def run(result: dict, out_path: str) -> None:
                 if obs_mode != "off" else None)
     result["obs_path"] = obs_path
     with RunLog(cfg.log_path, echo=False, base_t=base_wall) as runlog, \
-            obs_lib.Obs(obs_mode, path=obs_path,
-                        base_t=base_wall) as build_obs:
+            obs_lib.Obs(obs_mode, path=obs_path, base_t=base_wall,
+                        per_process=cfg.obs_per_process) as build_obs:
         if resuming:
             log(f"resuming from {ckpt}")
             # Verified load with previous-generation fallback: a
@@ -320,6 +340,22 @@ def run(result: dict, out_path: str) -> None:
                         log(f"health: [{ev['severity']}] {ev['name']}: "
                             f"{ev['msg']}")
                     if health_mon.worst == "critical":
+                        # Capture the evidence BEFORE halting
+                        # (cfg.auto_profile; obs/profiling.py): a
+                        # bounded jax.profiler window over the sick
+                        # build's next few steps, summarized next to
+                        # the recorder bundles.  The campaign is being
+                        # abandoned anyway -- profile_steps more steps
+                        # cost nothing against the allocation saved.
+                        extra = eng.trigger_auto_profile(
+                            "health_halt:" + ",".join(sorted(
+                                {e["name"]
+                                 for e in health_mon.events
+                                 if e.get("severity") == "critical"})))
+                        for _ in range(extra):
+                            if not eng.frontier:
+                                break
+                            eng.step()
                         result["stop_reason"] = "health_halt"
                         result["health"] = health_mon.summary()
                         log("HEALTH CRITICAL: checkpoint-and-halt "

@@ -25,9 +25,21 @@ prints:
   scripts/tpulint.py and analysis/recompile_guard.py, see
   docs/static_analysis.md) and is flagged like a latency regression.
 
+Fleet mode (fleet telemetry, docs/observability.md "Fleet
+telemetry"): ``--fleet`` treats the stream argument as a glob /
+directory / bare per-process stream name, merges every matching
+stream through obs/fleet.py, and renders a per-shard table, the
+exact counter rollup (counters SUM across shards' final snapshots;
+histograms merge bucket-wise), the critical-path rollup, and the
+straggler attribution.  Under ``--strict`` the fleet report exits
+nonzero when the directory mixes stream schema versions or a stream
+is missing its identity meta record -- silently folding
+unattributable streams together is how a fleet number lies.
+
 Usage:
     python scripts/obs_report.py RUN.obs.jsonl [--bench BENCH.json]
         [--drift PREV.obs.jsonl] [--json OUT.json] [--tol 0.10]
+    python scripts/obs_report.py 'run.obs.*.jsonl' --fleet [--strict]
 """
 
 from __future__ import annotations
@@ -56,10 +68,19 @@ def report(records: list[dict]) -> dict:
     meta = [r for r in records
             if r.get("kind") == "meta" and r.get("name") == "schema"]
     out["schema_version"] = meta[-1].get("version") if meta else None
-    if out["schema_version"] not in (None, SCHEMA_VERSION):
+    # v1 streams (pre-fleet, no identity record) read fine -- every
+    # field this report consumes predates v2 -- so only a version the
+    # reader does not know warns.
+    if out["schema_version"] not in (None, 1, SCHEMA_VERSION):
         out["schema_warning"] = (
             f"stream schema v{out['schema_version']} != reader "
             f"v{SCHEMA_VERSION}; fields may have moved")
+    ident = [r for r in records
+             if r.get("kind") == "meta" and r.get("name") == "stream"]
+    if ident:
+        out["identity"] = {k: ident[0].get(k) for k in
+                           ("run_id", "host", "pid", "process_index",
+                            "process_count")}
 
     # -- build trajectory (per-step events) --------------------------------
     steps = [r for r in records
@@ -135,6 +156,18 @@ def report(records: list[dict]) -> dict:
                 pipe["device_busy_frac"] = dfm
                 pipe["host_busy_frac"] = max(0.0, 1.0 - dfm)
             out["pipeline"] = pipe
+        # Per-step critical-path attribution (ISSUE 13): run-mean
+        # fraction of step wall per segment, from the cumulative
+        # build.cp_* gauges; checkpoint wall rides separately (it
+        # happens between steps).
+        cp = {seg: out["gauges"][f"build.cp_{seg}_frac"]
+              for seg in ("fill", "plan", "wait", "certify", "other")
+              if f"build.cp_{seg}_frac" in out["gauges"]}
+        if cp:
+            if "build.cp_checkpoint_s" in out["gauges"]:
+                cp["checkpoint_s"] = out["gauges"][
+                    "build.cp_checkpoint_s"]
+            out["critical_path"] = cp
         # Warm-rebuild reuse economy (partition/rebuild.py): counters +
         # the reuse_frac gauge, rendered and diff-flagged like the
         # pipeline gauges.
@@ -401,6 +434,14 @@ def render_text(rep: dict, flags: list[str], bench_path: str | None) -> str:
             f", spec hit rate {pipe.get('spec_hit_rate', 0.0):.2f}"
             f", spec waste {pipe.get('spec_waste_frac', 0.0):.3f}"
             f", dedup saved {int(pipe.get('dedup_saved', 0))}" + occ)
+    cp = rep.get("critical_path")
+    if cp:
+        segs = " / ".join(
+            f"{seg} {100 * cp.get(seg, 0.0):.0f}%"
+            for seg in ("fill", "plan", "wait", "certify", "other"))
+        tail = (f" (ckpt {cp['checkpoint_s']:.1f}s)"
+                if "checkpoint_s" in cp else "")
+        ln.append(f"critical path: {segs}{tail}")
     reb = rep.get("rebuild")
     if reb:
         ln.append(
@@ -438,6 +479,91 @@ def render_text(rep: dict, flags: list[str], bench_path: str | None) -> str:
     return "\n".join(ln)
 
 
+def fleet_report(streams) -> dict:
+    """Fleet view over N loaded streams (obs.fleet.StreamInfo): the
+    exact counter rollup, per-shard rows (each stream's own report),
+    the critical-path rollup (cp segment SECONDS summed across shards,
+    fractions of the summed step wall), straggler attribution, and
+    the strict-mode schema/identity issues."""
+    from explicit_hybrid_mpc_tpu.obs import fleet as fleet_lib
+
+    roll = fleet_lib.fleet_rollup(streams)
+    shards = {}
+    cp_s: dict[str, float] = {}
+    for s in streams:
+        shard_rep = report(s.records)
+        shards[s.shard] = shard_rep
+        for seg in ("fill", "plan", "wait", "certify", "other",
+                    "checkpoint"):
+            v = shard_rep.get("gauges", {}).get(f"build.cp_{seg}_s")
+            if v is not None:
+                cp_s[seg] = cp_s.get(seg, 0.0) + v
+    cp = None
+    step_total = sum(v for k, v in cp_s.items() if k != "checkpoint")
+    if step_total > 0:
+        cp = {seg: cp_s.get(seg, 0.0) / step_total
+              for seg in ("fill", "plan", "wait", "certify", "other")}
+        cp["checkpoint_s"] = cp_s.get("checkpoint", 0.0)
+    return {"n_streams": len(streams),
+            "run_ids": roll["run_ids"],
+            "rollup": {"counters": roll["counters"],
+                       "regions": roll["regions"],
+                       "histograms": {k: histogram_row(h) for k, h in
+                                      roll["histograms"].items()}},
+            "critical_path": cp,
+            "straggler": fleet_lib.straggler_report(streams),
+            "issues": fleet_lib.strict_issues(streams),
+            "shards": shards}
+
+
+def render_fleet(rep: dict) -> str:
+    ln = [f"fleet report: {rep['n_streams']} stream(s), run_ids "
+          f"{', '.join(rep['run_ids']) or '(none)'}"]
+    for shard in sorted(rep["shards"]):
+        sr = rep["shards"][shard]
+        b = sr.get("build", {})
+        ident = sr.get("identity") or {}
+        ln.append(
+            f"  shard {shard}: {sr['n_records']} records, schema "
+            f"v{sr.get('schema_version')}, host "
+            f"{ident.get('host', '?')}, regions {b.get('regions', '-')}"
+            f", {b.get('regions_per_s') or 0:.1f} regions/s"
+            if b else
+            f"  shard {shard}: {sr['n_records']} records, schema "
+            f"v{sr.get('schema_version')} (no build.step events)")
+    roll = rep["rollup"]
+    headline = {k: v for k, v in roll["counters"].items()
+                if k in ("build.steps", "build.leaves",
+                         "build.oracle_solves", "oracle.point_solves",
+                         "oracle.simplex_solves",
+                         "build.quarantined_cells")}
+    ln.append("rollup (counters sum across shards): "
+              + ", ".join(f"{k}={int(v)}" for k, v in
+                          sorted(headline.items())))
+    if roll.get("regions") is not None:
+        ln.append(f"rollup regions (max across shards): "
+                  f"{int(roll['regions'])}")
+    cp = rep.get("critical_path")
+    if cp:
+        ln.append("fleet critical path: " + " / ".join(
+            f"{seg} {100 * cp[seg]:.0f}%"
+            for seg in ("fill", "plan", "wait", "certify", "other"))
+            + f" (ckpt {cp.get('checkpoint_s', 0.0):.1f}s)")
+    strag = rep.get("straggler", {})
+    if strag.get("straggle_frac") is not None:
+        ln.append(
+            f"straggler: {strag['slowest']} at "
+            f"{100 * (1 - strag['straggle_frac']):.0f}% of "
+            f"{strag['fastest']}'s rate "
+            f"(straggle_frac {strag['straggle_frac']:.2f})")
+    elif not strag.get("concurrent"):
+        ln.append("straggler: shards not concurrent (restart chain / "
+                  "sequential sessions) -- attribution skipped")
+    for issue in rep.get("issues", []):
+        ln.append(f"  STRICT: {issue}")
+    return "\n".join(ln)
+
+
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("stream", help="obs JSONL stream path")
@@ -452,10 +578,29 @@ def main(argv: list[str] | None = None) -> int:
                     help="also write the structured report here")
     ap.add_argument("--tol", type=float, default=0.10,
                     help="relative regression tolerance (default 0.10)")
+    ap.add_argument("--fleet", action="store_true",
+                    help="the stream argument names N per-process "
+                         "streams (glob / directory / bare name): "
+                         "render the merged fleet view instead of one "
+                         "stream's report")
     ap.add_argument("--strict", action="store_true",
                     help="exit nonzero when any bench-diff or drift "
-                         "flag fires (CI mode)")
+                         "flag fires (CI mode); with --fleet, also "
+                         "when streams mix schema versions or lack "
+                         "identity meta records")
     args = ap.parse_args(argv)
+
+    if args.fleet:
+        from explicit_hybrid_mpc_tpu.obs import fleet as fleet_lib
+
+        streams = fleet_lib.load_fleet(args.stream)
+        frep = fleet_report(streams)
+        print(render_fleet(frep))
+        if args.json_out:
+            with open(args.json_out, "w") as f:
+                json.dump({"fleet": frep}, f, indent=2,
+                          default=lambda o: repr(o))
+        return 1 if (args.strict and frep["issues"]) else 0
 
     rep = report(load_jsonl(args.stream))
     bench_path = args.bench or latest_bench()

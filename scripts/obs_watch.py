@@ -20,9 +20,21 @@ Usage:
     python scripts/obs_watch.py RUN.obs.jsonl --once         # one pass
     python scripts/obs_watch.py RUN.obs.jsonl \
         --rule stall_s=120 --rule max_rescue_frac=0.1 --max-wall 3600
+    python scripts/obs_watch.py 'artifacts/run.obs.*.jsonl' --fleet
 
 ``--once`` evaluates the records already in the file and exits (no
 stall detection: a finished stream is not frozen, it is finished).
+
+``--fleet`` (fleet telemetry, docs/observability.md): the stream
+argument is a glob / directory / bare per-process stream name naming
+N streams; every stream feeds its own per-shard rule set (events gain
+a ``shard`` field) and the cross-shard rules fire on top --
+``health.shard_straggle`` when concurrent shards' build rates spread
+past ``max_shard_straggle_frac``, and ``health.fleet_stall``
+(critical) when EVERY stream goes silent for ``fleet_stall`` seconds
+(one silent shard still fires the per-stream ``stall_s`` rule with
+the shard named).  New per-process streams appearing mid-watch are
+picked up on the next poll.
 Rule schema + defaults: obs.health.DEFAULT_RULES (docs/observability.md).
 """
 
@@ -107,6 +119,88 @@ def watch(path: str, rules: dict | None = None, interval: float = 1.0,
     return mon.exit_code, mon
 
 
+def watch_fleet(pattern: str, rules: dict | None = None,
+                interval: float = 1.0, max_wall: float | None = None,
+                once: bool = False, out=None):
+    """Drive a FleetMonitor over every stream `pattern` names; returns
+    (exit_code, monitor).  See module docstring (--fleet)."""
+    from explicit_hybrid_mpc_tpu.obs import fleet as fleet_lib
+
+    if out is None:
+        out = sys.stdout
+    mon = fleet_lib.FleetMonitor(rules)
+    if once:
+        streams = fleet_lib.load_fleet(pattern)
+        for s in streams:
+            for rec in s.records:
+                for ev in mon.feed(s.shard, rec):
+                    _emit(ev, out)
+        for ev in mon.finalize(streams):
+            _emit(ev, out)
+        return mon.exit_code, mon
+
+    t_start = time.time()
+    state: dict[str, dict] = {}  # path -> {fh, buf, shard, done, last}
+    try:
+        while True:
+            now = time.time()
+            for path in fleet_lib.resolve_streams(pattern):
+                if path not in state:
+                    state[path] = {
+                        "fh": open(path), "buf": "", "done": False,
+                        "last": now,
+                        "shard": fleet_lib._shard_label(path, None)}
+            if not state:
+                if max_wall is not None and now - t_start >= max_wall:
+                    break
+                time.sleep(interval)
+                continue
+            for st in state.values():
+                chunk = st["fh"].read()
+                if not chunk:
+                    continue
+                st["last"] = now
+                st["buf"] += chunk
+                lines = st["buf"].split("\n")
+                st["buf"] = lines.pop()
+                for ln in lines:
+                    if not ln.strip():
+                        continue
+                    try:
+                        rec = json.loads(ln)
+                    except json.JSONDecodeError:
+                        continue
+                    for ev in mon.feed(st["shard"], rec):
+                        _emit(ev, out)
+                    if rec.get("kind") == "event" \
+                            and rec.get("name") == "build.done":
+                        st["done"] = True
+            if state and all(st["done"] for st in state.values()):
+                break
+            for st in state.values():
+                if not st["done"]:
+                    for ev in mon.check_stall(st["shard"],
+                                              now - st["last"]):
+                        _emit(ev, out)
+            idles = [now - st["last"] for st in state.values()
+                     if not st["done"]]
+            if idles:
+                for ev in mon.check_fleet_stall(min(idles)):
+                    _emit(ev, out)
+            for ev in mon.check_straggle_live():
+                _emit(ev, out)
+            if any(e["name"] == "health.fleet_stall"
+                   for e in mon.events):
+                break  # a frozen fleet will not unfreeze; stop burning
+            if max_wall is not None and now - t_start >= max_wall:
+                break
+            time.sleep(interval)
+    finally:
+        for st in state.values():
+            st["fh"].close()
+    return mon.exit_code, mon
+
+
 def _parse_rules(pairs: list[str]) -> dict:
     from explicit_hybrid_mpc_tpu.obs.health import rules_from_pairs
 
@@ -139,6 +233,11 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--once", action="store_true",
                     help="evaluate the existing records and exit "
                          "(no stall detection)")
+    ap.add_argument("--fleet", action="store_true",
+                    help="the stream argument names N per-process "
+                         "streams (glob / directory / bare name): "
+                         "per-shard rules plus the cross-shard "
+                         "straggler and fleet-stall rules")
     ap.add_argument("--json", dest="json_out", default=None,
                     help="write the monitor summary here on exit")
     args = ap.parse_args(argv)
@@ -146,10 +245,17 @@ def main(argv: list[str] | None = None) -> int:
     rules = _parse_rules(args.rule)
     if args.stall_s is not None:
         rules["stall_s"] = args.stall_s
-    rc, mon = watch(args.stream, rules=rules, interval=args.interval,
-                    max_wall=args.max_wall, once=args.once)
+    if args.fleet:
+        rc, mon = watch_fleet(args.stream, rules=rules,
+                              interval=args.interval,
+                              max_wall=args.max_wall, once=args.once)
+    else:
+        rc, mon = watch(args.stream, rules=rules, interval=args.interval,
+                        max_wall=args.max_wall, once=args.once)
     summ = mon.summary()
-    print(f"obs_watch: {summ['n_records']} records, "
+    counts = (f"{summ['n_shards']} shards"
+              if "n_shards" in summ else f"{summ['n_records']} records")
+    print(f"obs_watch: {counts}, "
           f"{summ['n_events']} health events, verdict {summ['worst']}",
           file=sys.stderr)
     if args.json_out:

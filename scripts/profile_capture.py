@@ -19,8 +19,6 @@ PROFILE_TIME_BUDGET, plus bench.py's BENCH_PLATFORM / BENCH_PROBE_TIMEOUT.
 
 from __future__ import annotations
 
-import glob
-import gzip
 import json
 import os
 import sys
@@ -30,6 +28,12 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from bench import choose_backend, log, warm_oracle  # noqa: E402
 
+# The Chrome-trace summarizer moved to library code (fleet telemetry:
+# the health-triggered AutoProfiler shares it); re-exported here for
+# existing consumers of this script's namespace.
+from explicit_hybrid_mpc_tpu.obs.profiling import (  # noqa: E402,F401
+    summarize_trace)
+
 OUT_PATH = os.environ.get("PROFILE_OUT", "artifacts/profile.json")
 
 
@@ -37,40 +41,6 @@ def _flush(result: dict) -> None:
     os.makedirs(os.path.dirname(OUT_PATH) or ".", exist_ok=True)
     with open(OUT_PATH, "w") as f:
         json.dump(result, f, indent=2)
-
-
-def summarize_trace(trace_dir: str, top_n: int = 25) -> dict:
-    """Top ops by summed duration from the Chrome-trace JSON(.gz) files
-    jax.profiler writes under <dir>/plugins/profile/<run>/."""
-    paths = (glob.glob(os.path.join(trace_dir, "**", "*.trace.json.gz"),
-                       recursive=True)
-             + glob.glob(os.path.join(trace_dir, "**", "*.trace.json"),
-                         recursive=True))
-    if not paths:
-        return {"error": f"no trace files under {trace_dir}"}
-    by_name: dict[str, float] = {}
-    pid_names: dict[int, str] = {}
-    total_events = 0
-    for path in paths:
-        opener = gzip.open if path.endswith(".gz") else open
-        with opener(path, "rt") as f:
-            data = json.load(f)
-        for ev in data.get("traceEvents", []):
-            if ev.get("ph") == "M" and ev.get("name") == "process_name":
-                pid_names[ev.get("pid")] = ev["args"].get("name", "")
-            if ev.get("ph") != "X" or "dur" not in ev:
-                continue
-            total_events += 1
-            name = ev.get("name", "?")[:120]
-            by_name[name] = by_name.get(name, 0.0) + ev["dur"]
-    top = sorted(by_name.items(), key=lambda kv: -kv[1])[:top_n]
-    return {
-        "trace_files": len(paths),
-        "events": total_events,
-        "tracks": sorted(set(pid_names.values())),
-        "top_ops_ms": [{"name": n, "total_ms": round(d / 1e3, 3)}
-                       for n, d in top],
-    }
 
 
 def run(result: dict) -> None:

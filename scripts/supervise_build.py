@@ -52,6 +52,15 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+def _new_run_id() -> str:
+    """Fresh chain-wide run id.  Inlined uuid (not obs.clock) so the
+    supervisor stays import-light: it must never be the process that
+    first pulls in the jax-importing package."""
+    import uuid
+
+    return uuid.uuid4().hex[:12]
+
+
 def _build_argv_value(argv: list[str], *names: str) -> str | None:
     """The value of the first of `names` present in a main.py argv
     (both ``--flag value`` and ``--flag=value`` spellings)."""
@@ -85,6 +94,12 @@ def run_supervised(build_argv: list[str], ckpt: str,
     """Run the build to completion under supervision; returns the
     summary dict (rc, restarts, attempts)."""
     env = dict(os.environ)
+    # One run id for the whole restart chain (obs/clock.py): every
+    # attempt's obs stream stamps the same EHM_RUN_ID into its
+    # identity record, so the fleet readers (obs_report --fleet) can
+    # attribute N per-process streams to ONE supervised run.  An id
+    # already in the environment (an outer launcher's) wins.
+    env.setdefault("EHM_RUN_ID", _new_run_id())
     attempts: list[dict] = []
     rc = -1
     for attempt in range(max_restarts + 1):

@@ -1,0 +1,498 @@
+"""Fleet telemetry (ISSUE 13): stream identity + clock anchoring,
+per-process stream naming, clock-aligned merge, exact counter rollup,
+fleet health rules, per-step critical-path attribution, and the
+health-triggered bounded auto-profile capture.
+"""
+
+import glob
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from explicit_hybrid_mpc_tpu import obs as obs_lib
+from explicit_hybrid_mpc_tpu.config import PartitionConfig
+from explicit_hybrid_mpc_tpu.obs import clock, fleet
+from explicit_hybrid_mpc_tpu.obs.sink import (SCHEMA_VERSION, JsonlSink,
+                                              load_jsonl)
+from explicit_hybrid_mpc_tpu.partition.frontier import build_partition
+from explicit_hybrid_mpc_tpu.problems.registry import make
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _script(name):
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    try:
+        return __import__(name)
+    finally:
+        sys.path.pop(0)
+
+
+def _write_stream(path, records, version=SCHEMA_VERSION, identity=None):
+    """Hand-written stream: schema record, optional identity record,
+    then `records` (each a full dict with t/kind/name)."""
+    with open(path, "w") as f:
+        f.write(json.dumps({"t": 0.0, "kind": "meta", "name": "schema",
+                            "version": version}) + "\n")
+        if identity is not None:
+            f.write(json.dumps({"t": identity.get("t", 0.0),
+                                "kind": "meta", "name": "stream",
+                                **identity}) + "\n")
+        for r in records:
+            f.write(json.dumps(r) + "\n")
+    return path
+
+
+# -- identity + clock ------------------------------------------------------
+
+def test_identity_record_and_anchor(tmp_path):
+    p = str(tmp_path / "x.obs.jsonl")
+    with obs_lib.Obs("jsonl", path=p):
+        pass
+    recs = load_jsonl(p)
+    assert recs[0]["name"] == "schema"
+    assert recs[0]["version"] == SCHEMA_VERSION == 2
+    ident = recs[1]
+    assert ident["kind"] == "meta" and ident["name"] == "stream"
+    for k in ("run_id", "host", "pid", "process_index", "process_count",
+              "wall_time", "t"):
+        assert k in ident, k
+    assert ident["pid"] == os.getpid()
+    # The anchor maps stream t onto the wall axis consistently.
+    off = clock.wall_offset(ident)
+    assert off is not None
+    assert clock.to_wall(ident, ident["t"]) == pytest.approx(
+        ident["wall_time"])
+
+
+def test_run_id_env_override(monkeypatch):
+    monkeypatch.setattr(clock, "_run_id", None)
+    monkeypatch.setenv(clock.RUN_ID_ENV, "deadbeef0123")
+    assert clock.run_id() == "deadbeef0123"
+    monkeypatch.setattr(clock, "_run_id", None)
+
+
+def test_process_coords():
+    from explicit_hybrid_mpc_tpu.parallel import distributed
+
+    coords = distributed.process_coords()
+    assert coords["process_index"] == 0
+    assert coords["process_count"] == 1
+    assert coords["n_local_devices"] >= 1
+
+
+# -- per-process naming + bare-name resolution -----------------------------
+
+def test_per_process_path_shapes():
+    assert fleet.per_process_path("a/b.obs.jsonl", 3, 77) \
+        == "a/b.obs.p3-77.jsonl"
+    assert fleet.per_process_path("noext", 0, 5) == "noext.p0-5"
+
+
+def test_bare_name_resolution(tmp_path):
+    bare = str(tmp_path / "run.obs.jsonl")
+    o = obs_lib.Obs("jsonl", path=bare, per_process=True)
+    o.event("tick", i=1)
+    o.close()
+    assert not os.path.exists(bare)
+    assert len(fleet.sibling_streams(bare)) == 1
+    # load_jsonl resolves the old bare name to the one sibling.
+    recs = load_jsonl(bare)
+    assert any(r.get("name") == "tick" for r in recs)
+    # A second sibling makes the bare name ambiguous: the reader must
+    # refuse to silently pick one shard.
+    _write_stream(str(tmp_path / "run.obs.p0-99999.jsonl"), [])
+    with pytest.raises(FileNotFoundError, match="fleet"):
+        load_jsonl(bare)
+    # ...but the fleet loader takes the whole family.
+    assert len(fleet.load_fleet(bare)) == 2
+
+
+# -- clock-aligned merge ---------------------------------------------------
+
+def test_merge_orders_by_wall_anchor(tmp_path):
+    """Two streams with skewed anchors: the same stream-local t values
+    must interleave by ABSOLUTE time, not by t."""
+    a = _write_stream(
+        str(tmp_path / "a.jsonl"),
+        [{"t": 1.0, "kind": "event", "name": "build.step", "step": 1},
+         {"t": 3.0, "kind": "event", "name": "build.step", "step": 2}],
+        identity={"t": 0.0, "wall_time": 1000.0, "run_id": "r", "pid": 1,
+                  "host": "h", "process_index": 0, "process_count": 2})
+    b = _write_stream(
+        str(tmp_path / "b.jsonl"),
+        [{"t": 1.0, "kind": "event", "name": "build.step", "step": 1},
+         {"t": 3.0, "kind": "event", "name": "build.step", "step": 2}],
+        identity={"t": 0.0, "wall_time": 1001.0, "run_id": "r", "pid": 2,
+                  "host": "h", "process_index": 1, "process_count": 2})
+    streams = fleet.load_fleet([a, b])
+    merged = fleet.merge_events(streams, kinds=("event",))
+    order = [(r["shard"], r["step"]) for r in merged]
+    assert order == [("p0:1", 1), ("p1:2", 1), ("p0:1", 2), ("p1:2", 2)]
+    assert [r["t_abs"] for r in merged] == [1001.0, 1002.0, 1003.0,
+                                            1004.0]
+
+
+# -- rollup ----------------------------------------------------------------
+
+def test_rollup_counters_sum_bit_exact(tmp_path):
+    big = 123_456_789_012_345
+    a = _write_stream(
+        str(tmp_path / "a.jsonl"),
+        [{"t": 1.0, "kind": "metrics", "name": "snapshot",
+          "counters": {"oracle.point_solves": big, "build.leaves": 7},
+          "gauges": {"build.regions": 7.0},
+          "histograms": {"x_s": {"bounds": [1.0, 2.0],
+                                 "counts": [1, 2, 3], "count": 6,
+                                 "sum": 9.0, "min": 0.5, "max": 4.0}}}],
+        identity={"t": 0.0, "wall_time": 10.0, "run_id": "r", "pid": 1,
+                  "host": "h", "process_index": 0, "process_count": 2})
+    b = _write_stream(
+        str(tmp_path / "b.jsonl"),
+        [{"t": 1.0, "kind": "metrics", "name": "snapshot",
+          "counters": {"oracle.point_solves": 987_654_321,
+                       "build.leaves": 5},
+          "gauges": {"build.regions": 12.0},
+          "histograms": {"x_s": {"bounds": [1.0, 2.0],
+                                 "counts": [0, 1, 0], "count": 1,
+                                 "sum": 1.5, "min": 1.5, "max": 1.5}}}],
+        identity={"t": 0.0, "wall_time": 11.0, "run_id": "r", "pid": 2,
+                  "host": "h", "process_index": 1, "process_count": 2})
+    roll = fleet.fleet_rollup(fleet.load_fleet([a, b]))
+    assert roll["counters"]["oracle.point_solves"] == big + 987_654_321
+    assert roll["counters"]["build.leaves"] == 12
+    assert roll["regions"] == 12.0  # gauges: max, not sum
+    h = roll["histograms"]["x_s"]
+    assert h["counts"] == [1, 3, 3] and h["count"] == 7
+    assert h["min"] == 0.5 and h["max"] == 4.0
+    assert roll["run_ids"] == ["r"]
+
+
+def test_v1_stream_tolerated_and_strict_issues(tmp_path):
+    v1 = _write_stream(str(tmp_path / "v1.jsonl"),
+                       [{"t": 1.0, "kind": "event", "name": "build.step",
+                         "step": 1, "regions": 5}], version=1)
+    v2 = _write_stream(
+        str(tmp_path / "v2.jsonl"), [],
+        identity={"t": 0.0, "wall_time": 1.0, "run_id": "r", "pid": 2,
+                  "host": "h", "process_index": 0, "process_count": 1})
+    streams = fleet.load_fleet([v1, v2])
+    assert streams[0].identity is None
+    assert streams[0].schema_version == 1
+    issues = fleet.strict_issues(streams)
+    assert any("mixed stream schema versions" in i for i in issues)
+    assert any("no stream-identity" in i for i in issues)
+    assert fleet.strict_issues([streams[1]]) == []
+
+
+# -- straggler attribution + fleet rules -----------------------------------
+
+def _progress_stream(tmp_path, name, wall0, rate, n=6, pid=1, idx=0):
+    recs = [{"t": float(i), "kind": "event", "name": "build.step",
+             "step": i, "regions": int(i * rate)}
+            for i in range(1, n + 1)]
+    return _write_stream(
+        str(tmp_path / name), recs,
+        identity={"t": 0.0, "wall_time": wall0, "run_id": "r",
+                  "pid": pid, "host": "h", "process_index": idx,
+                  "process_count": 2})
+
+
+def test_straggler_report_concurrent(tmp_path):
+    fast = _progress_stream(tmp_path, "fast.jsonl", 100.0, 100.0,
+                            pid=1, idx=0)
+    slow = _progress_stream(tmp_path, "slow.jsonl", 100.0, 10.0,
+                            pid=2, idx=1)
+    rep = fleet.straggler_report(fleet.load_fleet([fast, slow]))
+    assert rep["concurrent"]
+    assert rep["slowest"] == "p1:2" and rep["fastest"] == "p0:1"
+    assert rep["straggle_frac"] == pytest.approx(0.9)
+    # Sequential sessions (a restart chain) are not stragglers.
+    late = _progress_stream(tmp_path, "late.jsonl", 1000.0, 10.0,
+                            pid=3, idx=0)
+    rep = fleet.straggler_report(fleet.load_fleet([fast, late]))
+    assert not rep["concurrent"] and rep["straggle_frac"] is None
+
+
+def test_shard_labels_deduped_across_hosts(tmp_path):
+    """Two containerized replicas both running as pid 1 on different
+    hosts must not collapse into one shard row."""
+    for i, host in enumerate(("host-a", "host-b")):
+        _write_stream(
+            str(tmp_path / f"r{i}.jsonl"),
+            [{"t": 1.0, "kind": "metrics", "name": "snapshot",
+              "counters": {"build.leaves": 1}, "gauges": {},
+              "histograms": {}}],
+            identity={"t": 0.0, "wall_time": 100.0, "run_id": "r",
+                      "pid": 1, "host": host, "process_index": 0,
+                      "process_count": 2})
+    streams = fleet.load_fleet(str(tmp_path / "r*.jsonl"))
+    assert len({s.shard for s in streams}) == 2
+    roll = fleet.fleet_rollup(streams)
+    assert len(roll["per_shard"]) == 2
+    assert roll["counters"]["build.leaves"] == 2
+
+
+def test_straggler_pairwise_overlap(tmp_path):
+    """One sequential restart-chain session among concurrent shards
+    must not disable straggler attribution for the whole fleet."""
+    fast = _progress_stream(tmp_path, "fast.jsonl", 100.0, 100.0,
+                            pid=1, idx=0)
+    slow = _progress_stream(tmp_path, "slow.jsonl", 100.0, 10.0,
+                            pid=2, idx=1)
+    dead = _progress_stream(tmp_path, "dead.jsonl", 1000.0, 50.0,
+                            pid=3, idx=2)  # long after the others
+    rep = fleet.straggler_report(fleet.load_fleet([fast, slow, dead]))
+    assert rep["concurrent"]
+    assert rep["slowest"] == "p1:2" and rep["fastest"] == "p0:1"
+    assert rep["straggle_frac"] == pytest.approx(0.9)
+    assert rep["shards"]["p2:3"]["concurrent"] is False
+
+
+def test_fleet_monitor_rules(tmp_path):
+    fast = _progress_stream(tmp_path, "fast.jsonl", 100.0, 100.0,
+                            pid=1, idx=0)
+    slow = _progress_stream(tmp_path, "slow.jsonl", 100.0, 10.0,
+                            pid=2, idx=1)
+    streams = fleet.load_fleet([fast, slow])
+    mon = fleet.FleetMonitor()
+    for s in streams:
+        for r in s.records:
+            mon.feed(s.shard, r)
+    evs = mon.finalize(streams)
+    assert [e["name"] for e in evs] == ["health.shard_straggle"]
+    assert mon.worst == "warn" and mon.exit_code == 1
+    assert mon.finalize(streams) == []  # fires once
+    # Fleet stall: every shard silent past the rule -> critical.
+    evs = mon.check_fleet_stall(400.0)
+    assert [e["name"] for e in evs] == ["health.fleet_stall"]
+    assert mon.exit_code == 2
+    # Unknown rule names raise through the shared validator.
+    with pytest.raises(ValueError, match="unknown health rule"):
+        fleet.FleetMonitor({"bogus": 1.0})
+
+
+def test_obs_watch_fleet_once(tmp_path):
+    obs_watch = _script("obs_watch")
+    ok = _progress_stream(tmp_path, "run.obs.p0-1.jsonl", 100.0, 50.0,
+                          pid=1, idx=0)
+    _write_stream(
+        str(tmp_path / "run.obs.p0-2.jsonl"),
+        [{"t": 1.0, "kind": "event", "name": "health.quarantine",
+          "severity": "critical", "msg": "boom"}],
+        identity={"t": 0.0, "wall_time": 100.0, "run_id": "r", "pid": 2,
+                  "host": "h", "process_index": 0, "process_count": 2})
+    rc = obs_watch.main([str(tmp_path / "run.obs.p*.jsonl"),
+                         "--fleet", "--once"])
+    assert rc == 2  # the adopted critical event dominates
+    rc = obs_watch.main([ok, "--fleet", "--once"])
+    assert rc == 0
+
+
+def test_obs_report_fleet_and_strict(tmp_path, capsys):
+    obs_report = _script("obs_report")
+    _progress_stream(tmp_path, "f.obs.p0-1.jsonl", 100.0, 50.0,
+                     pid=1, idx=0)
+    _write_stream(str(tmp_path / "f.obs.p0-2.jsonl"),
+                  [{"t": 1.0, "kind": "metrics", "name": "snapshot",
+                    "counters": {"build.leaves": 3}, "gauges": {},
+                    "histograms": {}}], version=1)
+    pat = str(tmp_path / "f.obs.p*.jsonl")
+    assert obs_report.main([pat, "--fleet"]) == 0
+    out = capsys.readouterr().out
+    assert "fleet report: 2 stream(s)" in out
+    assert "rollup" in out
+    # --strict: the v1 identity-less stream gates the fold.
+    assert obs_report.main([pat, "--fleet", "--strict"]) == 1
+    out = capsys.readouterr().out
+    assert "STRICT" in out
+
+
+# -- build-integrated coverage ---------------------------------------------
+
+@pytest.fixture(scope="module")
+def cp_build(tmp_path_factory):
+    """One small DI build with obs + checkpoints: the critical-path
+    and checkpoint-snapshot fixtures."""
+    d = tmp_path_factory.mktemp("cp")
+    path = str(d / "run.obs.jsonl")
+    prob = make("double_integrator", N=3, theta_box=1.5)
+    cfg = PartitionConfig(eps_a=0.4, backend="cpu", batch_simplices=64,
+                          obs="jsonl", obs_path=path,
+                          checkpoint_every=4,
+                          checkpoint_path=str(d / "x.ckpt.pkl"))
+    res = build_partition(prob, cfg)
+    return path, res
+
+
+def test_critical_path_fractions_sum_to_one(cp_build):
+    """ISSUE acceptance: per-step critical-path fractions sum to
+    1.0 +- 0.02."""
+    path, res = cp_build
+    steps = [r for r in load_jsonl(path)
+             if r.get("kind") == "event" and r.get("name") == "build.step"]
+    assert steps
+    for s in steps:
+        parts = [s[f"cp_{seg}_s"] for seg in
+                 ("fill", "plan", "wait", "certify", "other")]
+        assert all(p >= 0 for p in parts)
+        assert sum(parts) / s["step_s"] == pytest.approx(1.0, abs=0.02)
+    # Cumulative gauges + stats agree and sum to ~1 too.
+    fr = {seg: res.stats[f"cp_{seg}_frac"]
+          for seg in ("fill", "plan", "wait", "certify", "other")}
+    assert sum(fr.values()) == pytest.approx(1.0, abs=0.02)
+    assert res.stats["cp_checkpoint_s"] >= 0
+
+
+def test_checkpoint_flushes_metrics_snapshot(cp_build):
+    """Every checkpoint writes a metrics snapshot BEFORE the
+    checkpoint.written injection site -- the fleet-reconciliation
+    prerequisite (a boundary-killed process has shipped its totals)."""
+    path, res = cp_build
+    recs = load_jsonl(path)
+    snaps = [r for r in recs if r["kind"] == "metrics"]
+    n_ckpts = res.stats["steps"] // 4
+    assert len(snaps) >= n_ckpts + 1  # per checkpoint + final
+    assert snaps[-1]["gauges"]["build.cp_checkpoint_s"] > 0
+
+
+def test_obs_report_renders_critical_path(cp_build):
+    obs_report = _script("obs_report")
+    path, _res = cp_build
+    rep = obs_report.report(load_jsonl(path))
+    cp = rep["critical_path"]
+    assert sum(cp[s] for s in ("fill", "plan", "wait", "certify",
+                               "other")) == pytest.approx(1.0, abs=0.02)
+    assert "checkpoint_s" in cp
+    text = obs_report.render_text(rep, [], None)
+    assert "critical path:" in text
+    assert rep["identity"]["pid"] == os.getpid()
+
+
+# -- auto-profile (health-triggered bounded capture) -----------------------
+
+def test_auto_profile_on_injected_stall(tmp_path):
+    """ISSUE acceptance: an injected hang triggers exactly ONE bounded
+    auto-profile capture with a valid summarized bundle, and obs_watch
+    exits 2 on the same stream."""
+    from explicit_hybrid_mpc_tpu import faults as faults_lib
+    from explicit_hybrid_mpc_tpu.faults.plan import FaultPlan
+
+    path = str(tmp_path / "run.obs.jsonl")
+    prob = make("double_integrator", N=3, theta_box=1.5)
+    cfg = PartitionConfig(eps_a=0.4, backend="cpu", batch_simplices=64,
+                          obs="jsonl", obs_path=path, auto_profile=True,
+                          profile_steps=2,
+                          recorder_dir=str(tmp_path / "repro"),
+                          health_rules=(("stall_s", 0.2),))
+    # TWO hangs: the second stall must NOT open a second capture
+    # (max_captures=1 -- bounded by design).
+    plan = FaultPlan(faults=(
+        {"site": "oracle.wait", "kind": "hang", "at": 2, "hang_s": 0.4},
+        {"site": "oracle.wait", "kind": "hang", "at": 7, "hang_s": 0.4}))
+    with faults_lib.activate(plan):
+        res = build_partition(prob, cfg)
+    assert res.stats["regions"] > 0
+    recs = load_jsonl(path)
+    assert any(r.get("name") == "health.stall" for r in recs)
+    caps = [r for r in recs if r.get("name") == "profile.capture"]
+    assert len(caps) == 1
+    bundles = glob.glob(str(tmp_path / "repro" / "auto_profile_*.json"))
+    assert len(bundles) == 1
+    with open(bundles[0]) as f:
+        bundle = json.load(f)
+    assert bundle["reason"] == "health.stall"
+    assert "error" not in bundle
+    summ = bundle["trace_summary"]
+    assert summ.get("trace_files", 0) >= 1
+    assert isinstance(summ.get("top_ops_ms"), list)
+    snaps = [r for r in recs if r["kind"] == "metrics"]
+    assert snaps[-1]["counters"]["build.auto_profiles"] == 1
+    # The same schedule through the external watcher: exit 2.
+    obs_watch = _script("obs_watch")
+    rc, _mon = obs_watch.watch(path, once=True)
+    assert rc == 2
+
+
+def test_trigger_auto_profile_external(tmp_path):
+    """The long_build halt path: an external driver can open the
+    bounded capture and drive it to completion with its own steps."""
+    from explicit_hybrid_mpc_tpu.partition.frontier import (FrontierEngine,
+                                                            make_oracle)
+
+    path = str(tmp_path / "run.obs.jsonl")
+    prob = make("double_integrator", N=3, theta_box=1.5)
+    cfg = PartitionConfig(eps_a=0.5, backend="cpu", batch_simplices=32,
+                          obs="jsonl", obs_path=path, auto_profile=True,
+                          profile_steps=2,
+                          recorder_dir=str(tmp_path / "repro"))
+    eng = FrontierEngine(prob, make_oracle(prob, cfg), cfg)
+    eng.step()
+    extra = eng.trigger_auto_profile("health_halt:test")
+    assert extra == 2
+    for _ in range(extra):
+        if eng.frontier:
+            eng.step()
+    eng.finish_obs()
+    bundles = glob.glob(str(tmp_path / "repro" / "auto_profile_*.json"))
+    assert len(bundles) == 1
+    # The budget is spent: a second trigger is refused.
+    assert eng.trigger_auto_profile("again") == 0
+
+
+def test_auto_profile_off_by_default(tmp_path):
+    from explicit_hybrid_mpc_tpu.partition.frontier import (FrontierEngine,
+                                                            make_oracle)
+
+    cfg = PartitionConfig(eps_a=0.5, backend="cpu", batch_simplices=32)
+    eng = FrontierEngine(make("double_integrator", N=3, theta_box=1.5),
+                         make_oracle(make("double_integrator", N=3,
+                                          theta_box=1.5), cfg), cfg)
+    assert eng._auto_prof is None
+    assert eng.trigger_auto_profile("nope") == 0
+
+
+# -- satellites ------------------------------------------------------------
+
+def test_serve_replica_identity_event():
+    import types
+
+    from explicit_hybrid_mpc_tpu.serve.scheduler import RequestScheduler
+
+    o = obs_lib.Obs("jsonl")
+    reg = types.SimpleNamespace(param_dim=lambda name: None, lease=None)
+    sched = RequestScheduler(reg, "ctl-a", max_batch=8, obs=o)
+    try:
+        evs = [r for r in o.sink.records
+               if r.get("name") == "serve.replica"]
+        assert len(evs) == 1
+        assert evs[0]["controller"] == "ctl-a"
+        assert evs[0]["pid"] == os.getpid()
+        assert evs[0]["run_id"] == clock.run_id()
+    finally:
+        sched.close(timeout=5.0)
+
+
+def test_bench_gate_row_carries_fleet_keys():
+    bench_gate = _script("bench_gate")
+    row = bench_gate.summarize(
+        {"value": 1.0, "platform": "cpu", "run_id": "abc123",
+         "obs_schema_version": 2, "cp_wait_frac": 0.7,
+         "cp_checkpoint_s": 0.1}, "BENCH_x.json")
+    assert row["run_id"] == "abc123"
+    assert row["obs_schema_version"] == 2
+    assert row["cp_wait_frac"] == 0.7
+    assert row["cp_checkpoint_s"] == 0.1
+
+
+def test_health_rules_include_fleet_rules():
+    from explicit_hybrid_mpc_tpu.obs.health import (DEFAULT_RULES,
+                                                    rules_from_pairs)
+
+    assert "max_shard_straggle_frac" in DEFAULT_RULES
+    assert "fleet_stall" in DEFAULT_RULES
+    assert rules_from_pairs([("fleet_stall", 10.0)])["fleet_stall"] \
+        == 10.0

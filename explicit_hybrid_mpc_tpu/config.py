@@ -315,6 +315,41 @@ class PartitionConfig:
     # once, not on every remaining batch of a multi-hour campaign.
     # (Not a padding bucket -- a failure COUNT; pow-2 is meaningless.)
     device_failure_cap: int = 3  # tpulint: disable=recompile-hazard -- failure count, not a shape
+    # Pod-scale sharded frontier (partition/shard.py; docs/perf.md
+    # "Sharded frontier").  When True and more than one shard resolves
+    # (shard_count, else jax.process_count()), each process runs the
+    # pipelined frontier over its OWN round-robin share of the root
+    # simplices with its oracle on its local devices -- no lockstep
+    # host replication, no per-step collectives.  Cross-shard vertex
+    # dedup goes through the asynchronous exchange under shard_dir
+    # (a directory every shard can reach): a deterministic ownership
+    # hash assigns every (vertex, delta) cell to exactly one shard,
+    # so summed point_solves equal the single-process build's.  The
+    # merged tree is node-for-node identical to the single-process
+    # build (canonical comparison; payload-ulp caveat documented).
+    # Single-process runs (or shard_count 1) are behavior-identical
+    # to shard_frontier=False.
+    shard_frontier: bool = False
+    # Exchange/result directory shared by every shard (required when
+    # sharding is active; the CLI derives <output>.shard).
+    shard_dir: Optional[str] = None
+    # Explicit shard coordinates (tests / external launchers); None =
+    # jax.process_index() / jax.process_count().
+    shard_index: Optional[int] = None
+    shard_count: Optional[int] = None
+    # Budget for a remote cell before the requester re-solves it
+    # locally (liveness over the zero-duplicate guarantee -- loud:
+    # shard.request_timeout event + shard.fallback_cells counter).
+    shard_timeout_s: float = 300.0
+    # Asynchronous host-certify (partition/pipeline.py): a background
+    # waiter thread resolves the in-flight lookahead programs of steps
+    # k+1.. WHILE the main thread runs step k's certify/commit host
+    # wall, so the serialized cp_wait share of the next step shrinks
+    # (the results are the identical device programs, resolved
+    # earlier: trees are bit-identical with the flag on or off).  Off
+    # by default; bench.py --multichip measures the cp-breakdown
+    # delta.
+    async_certify: bool = False
     # Deterministic fault-injection plan (faults/plan.py FaultPlan, a
     # dict, or a path to a plan JSON; the EHM_FAULT_PLAN env var is the
     # subprocess surface).  None = no injection (the production
@@ -364,6 +399,18 @@ class PartitionConfig:
             raise ValueError("oracle_retry_backoff_s must be >= 0")
         if self.device_failure_cap < 1:
             raise ValueError("device_failure_cap must be >= 1")
+        if self.shard_timeout_s <= 0:
+            raise ValueError("shard_timeout_s must be > 0")
+        if self.shard_count is not None and self.shard_count < 1:
+            raise ValueError("shard_count must be >= 1")
+        if self.shard_index is not None:
+            if self.shard_index < 0:
+                raise ValueError("shard_index must be >= 0")
+            if (self.shard_count is not None
+                    and self.shard_index >= self.shard_count):
+                raise ValueError(
+                    f"shard_index {self.shard_index} out of range for "
+                    f"shard_count {self.shard_count}")
         if self.health_rules:
             # Validate rule names eagerly: a typo'd rule that silently
             # never fires defeats the watchdog's purpose.

@@ -97,6 +97,29 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--dedup-window", type=int, default=None, metavar="K",
                    help="max in-flight vertices tracked for cross-batch "
                    "solve dedup (default 8192)")
+    p.add_argument("--shard-frontier", action="store_true",
+                   help="pod-scale sharded frontier (partition/"
+                        "shard.py): each jax.distributed process "
+                        "builds its own round-robin share of the root "
+                        "simplices on its local devices, with cross-"
+                        "host vertex dedup through the asynchronous "
+                        "exchange under --shard-dir; the merged tree "
+                        "is node-for-node identical to the single-"
+                        "process build (launch with JAX_COORDINATOR_"
+                        "ADDRESS/JAX_NUM_PROCESSES/JAX_PROCESS_ID, "
+                        "e.g. via scripts/shard_launch.py)")
+    p.add_argument("--shard-dir", metavar="DIR", default=None,
+                   help="exchange/result directory shared by every "
+                        "shard (default PREFIX.shard)")
+    p.add_argument("--shard-timeout", type=float, default=None,
+                   metavar="S",
+                   help="remote-cell wait budget before a shard "
+                        "re-solves locally (default 300)")
+    p.add_argument("--async-certify", action="store_true",
+                   help="background waiter resolves in-flight "
+                        "lookahead programs while the host certifies "
+                        "(partition/pipeline.py): trees bit-identical, "
+                        "serialized cp_wait share shrinks")
     p.add_argument("--rebuild-from", "--from", dest="rebuild_from",
                    metavar="PRIOR", default=None,
                    help="incremental warm rebuild (partition/rebuild.py"
@@ -259,6 +282,21 @@ def main(argv: list[str] | None = None) -> int:
         print("\n".join(names()))
         return 0
 
+    # Sharded runs: the per-process suffix (checkpoints, logs) comes
+    # from the launcher's env (scripts/shard_launch.py and any pod
+    # launcher MUST export JAX_PROCESS_ID alongside the coordinator
+    # vars) so it is known BEFORE any jax import, and each process
+    # resumes its OWN shard checkpoint.  A degraded single-shard
+    # --shard-frontier run (no coordinator env) saves UNSUFFIXED
+    # checkpoints -- the suffix applies only when the suffixed
+    # generation actually exists, so both shapes resume.
+    shard_pidx = int(os.environ.get("JAX_PROCESS_ID", "0") or 0) \
+        if args.shard_frontier else 0
+    if args.shard_frontier and args.resume:
+        cand = f"{args.resume}.p{shard_pidx}"
+        if os.path.exists(cand) or os.path.exists(cand + ".prev"):
+            args.resume = cand
+
     snapshot = None
     if args.resume:
         # Loaded here, before the platform-pin decision: on --resume the
@@ -285,6 +323,14 @@ def main(argv: list[str] | None = None) -> int:
         import jax
 
         jax.config.update("jax_platforms", "cpu")
+
+    if args.shard_frontier:
+        # Multi-process rendezvous BEFORE any device query (a sharded
+        # launch without coordinator env degrades to a single-shard
+        # run, which is behavior-identical to the plain build).
+        from explicit_hybrid_mpc_tpu.parallel import distributed
+
+        distributed.init_distributed()
 
     from explicit_hybrid_mpc_tpu.config import PartitionConfig
     from explicit_hybrid_mpc_tpu.partition.frontier import (FrontierEngine,
@@ -325,12 +371,20 @@ def main(argv: list[str] | None = None) -> int:
         checkpoint_every=args.checkpoint_every,
         checkpoint_path=(f"{prefix}.ckpt.pkl"
                          if args.checkpoint_every else None),
-        log_path=f"{prefix}.log.jsonl", precision=args.precision,
+        # Per-process log stream under sharding (the engine suffixes
+        # the checkpoint itself): two shards appending one JSONL file
+        # would interleave torn lines.
+        log_path=(f"{prefix}.log.jsonl.p{shard_pidx}"
+                  if args.shard_frontier else f"{prefix}.log.jsonl"),
+        precision=args.precision,
         profile_path=args.profile, profile_steps=args.profile_steps,
         obs=args.obs,
         obs_path=(args.obs_path or f"{prefix}.obs.jsonl"
                   if args.obs != "off" else None),
-        obs_per_process=args.obs_per_process,
+        # Sharded builds force per-process obs streams: N shards
+        # sharing one configured path would interleave one file.
+        obs_per_process=(args.obs_per_process
+                         or (args.shard_frontier and args.obs != "off")),
         auto_profile=args.auto_profile,
         # --recorder-dir implies --recorder: naming a bundle directory
         # and silently recording nothing would be the worst reading.
@@ -342,7 +396,13 @@ def main(argv: list[str] | None = None) -> int:
         solve_timeout_s=args.solve_timeout,
         fault_plan=args.fault_plan,
         rebuild_from=args.rebuild_from,
-        rebuild_strict_provenance=args.strict_provenance)
+        rebuild_strict_provenance=args.strict_provenance,
+        shard_frontier=args.shard_frontier,
+        shard_dir=(args.shard_dir or f"{prefix}.shard"
+                   if args.shard_frontier else args.shard_dir),
+        **({"shard_timeout_s": args.shard_timeout}
+           if args.shard_timeout is not None else {}),
+        async_certify=args.async_certify)
 
     if snapshot is not None:
         # SOLVER flags (precision/backend/eps/batch...) come from the
@@ -432,7 +492,16 @@ def main(argv: list[str] | None = None) -> int:
             oracle_retry_attempts=cfg.oracle_retry_attempts,
             oracle_retry_backoff_s=cfg.oracle_retry_backoff_s,
             device_failure_cap=cfg.device_failure_cap,
-            fault_plan=cfg.fault_plan)
+            fault_plan=cfg.fault_plan,
+            # Sharding/async-certify are run-scoped like the pipeline
+            # knobs: they change where work runs and when waits block,
+            # never a solved value -- a sharded resume passes
+            # --shard-frontier again (same launcher env => same shard
+            # coordinates and per-process checkpoint suffix).
+            shard_frontier=cfg.shard_frontier,
+            shard_dir=cfg.shard_dir,
+            shard_timeout_s=cfg.shard_timeout_s,
+            async_certify=cfg.async_certify)
 
     # Built from the FINAL cfg: on resume that is the snapshot's problem +
     # constructor args, so matrix shapes always match the restored cache.
@@ -471,6 +540,14 @@ def main(argv: list[str] | None = None) -> int:
         eng = FrontierEngine(problem, oracle, cfg, log)
         res = eng.run()
 
+    if args.shard_frontier:
+        # Every shard holds the identical merged result; only the
+        # owner writes the shared outputs (the per-shard trees/stats
+        # live under --shard-dir regardless).
+        from explicit_hybrid_mpc_tpu.parallel import distributed
+
+        if not distributed.is_frontier_owner():
+            return 0
     res.tree.save(f"{prefix}.tree.pkl")
     with open(f"{prefix}.stats.json", "w") as f:
         json.dump(res.stats, f, indent=2)

@@ -280,6 +280,7 @@ def fleet_rollup(streams: list[StreamInfo]) -> dict:
     run_ids = set()
     for s in streams:
         snap = _last_snapshot(s.records) or {}
+        gauges = dict(snap.get("gauges", {}) or {})
         row = {"path": s.path,
                "schema_version": s.schema_version,
                "identity": ({k: s.identity.get(k) for k in
@@ -287,7 +288,18 @@ def fleet_rollup(streams: list[StreamInfo]) -> dict:
                               "process_count")}
                             if s.identity else None),
                "counters": dict(snap.get("counters", {}) or {}),
-               "gauges": dict(snap.get("gauges", {}) or {}),
+               "gauges": gauges,
+               # Per-shard critical-path decomposition (sharded
+               # frontier: every shard has its OWN fill/plan/wait/
+               # certify profile -- a straggler's certify-bound shard
+               # is invisible in any cross-shard fold, so the
+               # fractions stay per-shard by design;
+               # docs/observability.md "Fleet telemetry").
+               "cp": {seg: gauges.get(f"build.cp_{seg}_frac")
+                      for seg in ("fill", "plan", "wait", "certify",
+                                  "other")
+                      if gauges.get(f"build.cp_{seg}_frac")
+                      is not None},
                "build": _shard_build(s.records),
                "wall_offset": s.wall_offset}
         per_shard[s.shard] = row
@@ -312,6 +324,12 @@ def fleet_rollup(streams: list[StreamInfo]) -> dict:
            "counters": counters,
            "histograms": merged_h,
            "regions": max(regions) if regions else None,
+           # Sharded-frontier builds certify DISJOINT subtrees: their
+           # total is the per-shard SUM, not the lockstep/restart max
+           # above.  Both are reported; the consumer picks by build
+           # mode (fleet_smoke --sharded sums, the supervised-restart
+           # smoke maxes).
+           "regions_sum": sum(regions) if regions else None,
            "per_shard": per_shard}
     if hist_notes:
         out["histogram_notes"] = hist_notes

@@ -33,11 +33,19 @@ def init_distributed(coordinator_address: Optional[str] = None,
     """
     import os
 
-    env_configured = ("JAX_COORDINATOR_ADDRESS" in os.environ
-                      or "COORDINATOR_ADDRESS" in os.environ)
-    if (num_processes is None and coordinator_address is None
-            and not env_configured):
+    env = os.environ
+    if coordinator_address is None:
+        coordinator_address = (env.get("JAX_COORDINATOR_ADDRESS")
+                               or env.get("COORDINATOR_ADDRESS"))
+    if num_processes is None and env.get("JAX_NUM_PROCESSES"):
+        num_processes = int(env["JAX_NUM_PROCESSES"])
+    if process_id is None and env.get("JAX_PROCESS_ID"):
+        process_id = int(env["JAX_PROCESS_ID"])
+    if num_processes is None and coordinator_address is None:
         return 0  # single process, nothing to coordinate
+    state = getattr(jax.distributed, "global_state", None)
+    if state is not None and getattr(state, "client", None) is not None:
+        return jax.process_index()  # already initialized (idempotent)
     jax.distributed.initialize(coordinator_address=coordinator_address,
                                num_processes=num_processes,
                                process_id=process_id)
@@ -71,6 +79,58 @@ def is_frontier_owner() -> bool:
     return jax.process_index() == 0
 
 
+def local_contiguous_block(idx_map: dict, shape) -> "tuple | None":
+    """(lo, hi) when this process's addressable shards form one
+    contiguous, gap-free, equal-sized block of dim-0 rows -- the only
+    layout ``jax.make_array_from_process_local_data`` stages correctly
+    from a dim-0 slice of the host-global array.  None otherwise:
+
+    - any shard slicing a NON-leading dimension (a (batch, delta)
+      sharding whose delta axis crosses processes);
+    - permuted/interleaved device orders whose local rows are not one
+      run (e.g. a mesh built from an interleaved global device list);
+    - unequal per-device row counts (never produced by NamedSharding
+      over an even mesh, but cheap to reject rather than assume).
+
+    The old heuristic inferred this from min/max starts and a global
+    device-count proportionality test; an untested layout could pass
+    it and stage the WRONG rows, or silently hit the slow callback
+    path.  This predicate is explicit and unit-tested
+    (tests/test_distributed.py, tests/_mp_worker.py permuted-mesh
+    mode)."""
+    blocks = []
+    for idx in idx_map.values():
+        if len(idx) < 1:
+            return None
+        for k, sl in enumerate(idx[1:], start=1):
+            if (sl.start not in (None, 0)
+                    or sl.stop not in (None, shape[k])
+                    or sl.step not in (None, 1)):
+                return None  # slices a trailing dim: not dim-0 only
+        s0 = idx[0]
+        if s0.step not in (None, 1):
+            return None
+        blocks.append((s0.start or 0,
+                       shape[0] if s0.stop is None else s0.stop))
+    if not blocks:
+        return None
+    # Deduplicate REPLICATED blocks first: under a (batch, delta) mesh
+    # a P("batch") sharding hands every local delta-axis device the
+    # SAME dim-0 slice -- duplicates are replication, not overlap, and
+    # rejecting them would silently demote every delta-sharded
+    # multi-process mesh to the slow callback path.
+    blocks = sorted(set(blocks))
+    sizes = {b - a for a, b in blocks}
+    if len(sizes) != 1:
+        return None
+    expect = blocks[0][0]
+    for a, b in blocks:
+        if a != expect:
+            return None  # gap or overlap: not one contiguous run
+        expect = b
+    return blocks[0][0], expect
+
+
 def stage_batch(sharding, x: "np.ndarray"):
     """Stage a host-global batch array for an SPMD solve step.
 
@@ -80,20 +140,18 @@ def stage_batch(sharding, x: "np.ndarray"):
     replacement for the reference's scheduler->worker branch messages);
     each process contributes only the row-block its addressable devices
     own, via `jax.make_array_from_process_local_data` -- no process ever
-    materializes another's device shards.
+    materializes another's device shards.  Layouts whose local rows are
+    not one contiguous dim-0 block (see `local_contiguous_block`) fall
+    back to the callback API, which handles any layout.
     """
     if jax.process_count() == 1:
         return jax.device_put(x, sharding)
     idx_map = sharding.addressable_devices_indices_map(x.shape)
-    starts = [s[0].start or 0 for s in idx_map.values()]
-    stops = [x.shape[0] if s[0].stop is None else s[0].stop
-             for s in idx_map.values()]
-    lo, hi = min(starts), max(stops)
-    if (hi - lo) * len(jax.devices()) != x.shape[0] * len(idx_map):
-        # Non-contiguous local rows (exotic device order): fall back to
-        # the callback API, which handles any layout.
+    block = local_contiguous_block(idx_map, x.shape)
+    if block is None:
         return jax.make_array_from_callback(
             x.shape, sharding, lambda idx: x[idx])
+    lo, hi = block
     return jax.make_array_from_process_local_data(sharding, x[lo:hi],
                                                   x.shape)
 

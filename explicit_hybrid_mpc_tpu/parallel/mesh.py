@@ -152,7 +152,14 @@ class MeshSolver:
 
         self.mesh = mesh
         self.n_batch = mesh.shape["batch"]
-        self.multiprocess = jax.process_count() > 1
+        # Replicate outputs only when the MESH actually spans
+        # processes: a sharded-frontier build runs a process-LOCAL
+        # mesh inside a multi-process job, and the old process-count
+        # test would have paid a pointless all-gather spec (and
+        # routed staging through the cross-process path) for it.
+        pidx = jax.process_index()
+        self.multiprocess = any(
+            d.process_index != pidx for d in mesh.devices.flat)
         n_delta_shards = mesh.shape["delta"]
         prob, self.nd = _replicate_pad_deltas(prob, n_delta_shards)
         # Stage the (constant) problem arrays in their delta-sharded layout

@@ -35,8 +35,10 @@ re-plan.
 from __future__ import annotations
 
 import collections
+import itertools
 import os
 import pickle
+import threading
 import time
 import warnings
 
@@ -248,7 +250,21 @@ class FrontierEngine:
                       geometry.box_triangulation(
                           problem.theta_lb, problem.theta_ub,
                           getattr(problem, "root_splits", None))]
-        self.frontier: collections.deque[int] = collections.deque(self.roots)
+        # Pod-scale sharded frontier (partition/shard.py): when active,
+        # THIS process's frontier holds only its round-robin share of
+        # the roots; cross-shard vertex dedup goes through the
+        # asynchronous exchange (requests posted at plan time, results
+        # collected before certify).  None on single-process runs --
+        # every hook below is a None-test.
+        self._shard = None
+        if getattr(cfg, "shard_frontier", False):
+            from explicit_hybrid_mpc_tpu.partition.shard import (
+                ShardContext)
+
+            self._shard = ShardContext.from_config(self, cfg)
+        self.frontier: collections.deque[int] = collections.deque(
+            self.roots if self._shard is None
+            else self._shard.owned_roots(self.roots))
         self.cache = VertexCache()
         self.steps = 0
         self.n_uncertified = 0
@@ -261,6 +277,13 @@ class FrontierEngine:
         self._full_mask = np.ones(oracle.can.n_delta, dtype=bool)
         self._fb_oracle: Oracle | None = None
         self._oracle_s = 0.0
+        # Serializes every oracle query/wait against the async-certify
+        # background waiter (partition/pipeline.py _resolve): oracle
+        # counters and the failure/degrade machinery are not
+        # thread-safe.  Reentrant -- _resolve holds it across
+        # _wait_or_fallback.  Uncontended cost is nanoseconds, so the
+        # async_certify=False path is unaffected.
+        self._oracle_lock = threading.RLock()
         # vertex key -> number of OPEN simplices (frontier + in-flight)
         # referencing it.  Every future simplex is a child of an open one,
         # so its vertices are open-simplex vertices or new bisection
@@ -275,7 +298,7 @@ class FrontierEngine:
         # speculative batches are idle-device fillers and are skipped
         # while the device is already the bottleneck.
         self.device_frac_ema = 0.0
-        for n in self.roots:
+        for n in self.frontier:
             self._retain(n)
         # node -> {delta: lower bound on min_R V_delta} inherited from
         # ancestors.  +inf = Farkas-certified infeasible on an ancestor
@@ -570,6 +593,7 @@ class FrontierEngine:
         oracle's statistics."""
         t0 = time.perf_counter()
         try:
+            self._oracle_lock.acquire()
             if not self._degraded:
                 try:
                     # The span doubles as a device-trace annotation
@@ -601,6 +625,7 @@ class FrontierEngine:
                 err = None
             return self._recover(method, args, err)
         finally:
+            self._oracle_lock.release()
             self._oracle_s += time.perf_counter() - t0
 
     def _keys(self, node: int) -> list[bytes]:
@@ -619,10 +644,21 @@ class FrontierEngine:
             self._refcount[k] += 1
 
     def _release(self, node: int) -> None:
-        for k in self._keys(node):
+        keys = self._keys(node)
+        verts = self.tree.vertices[node] if self._shard is not None \
+            else None
+        for vi, k in enumerate(keys):
             c = self._refcount[k] - 1
             if c <= 0:
                 del self._refcount[k]
+                if verts is not None:
+                    # Sharded frontier: stash owned boundary rows into
+                    # the exchange store before they vanish (a late
+                    # peer request must never re-solve an owned cell;
+                    # partition/shard.py note_evict).
+                    row = self.cache.get_key(k)
+                    if row is not None:
+                        self._shard.note_evict(k, verts[vi], row)
                 self.cache.evict_key(k)
             else:
                 self._refcount[k] = c
@@ -741,8 +777,28 @@ class FrontierEngine:
                             donor[k2] = drow
         pb = _PlanBuilder(self.oracle.can, use_warm)
         n_skips = n_new = 0
+        # Remote cells (sharded frontier): (key, theta, delta indices)
+        # a peer shard owns -- requested asynchronously here, collected
+        # by the step before certify (window=None plans only).
+        remote: list[tuple[bytes, np.ndarray, np.ndarray]] = []
+        shard = self._shard
         for k, m in need.items():
             row = self.cache.get_key(k)
+            if shard is not None:
+                # Consume any exchange coverage first (a cell a peer
+                # published -- or this shard solved on a peer's behalf
+                # -- must never be re-solved), then route what is still
+                # missing of a remotely-owned vertex through the
+                # exchange instead of the local plan.
+                miss0 = m if row is None else (m & ~row[7])
+                if miss0.any() and shard.take(k, miss0):
+                    row = self.cache.get_key(k)
+                    miss0 = m & ~row[7]
+                if miss0.any() and shard.is_remote(k):
+                    shard.request(k, vert[k], miss0)
+                    if window is None:
+                        remote.append((k, vert[k], np.where(miss0)[0]))
+                    continue
             drow = donor.get(k) if use_warm else None
             if row is None:
                 if m.all():
@@ -780,9 +836,35 @@ class FrontierEngine:
                 # unmasked build's.
                 n_new += 1
             pb.add_pair(k, ds, vert[k], drow)
-        if pb.empty:
+        if pb.empty and not remote:
             return None
-        return pb.finish(n_skips, n_new + pb.n_grid)
+        plan = pb.finish(n_skips, n_new + pb.n_grid)
+        if remote:
+            plan["remote"] = remote
+        return plan
+
+    def _shard_prefetch(self) -> None:
+        """Post exchange requests for every remotely-owned missing
+        cell visible in the frontier's head (bounded; see step()).
+        Store-covered cells are skipped -- they will be consumed at
+        plan time without a request."""
+        sh = self._shard
+        use_mask = self._use_mask()
+        limit = 4 * self.cfg.batch_simplices
+        for n in itertools.islice(self.frontier, 0, limit):
+            act = self._active_delta_mask(n, use_mask)
+            for k, v in zip(self._keys(n), self.tree.vertices[n]):
+                if not sh.is_remote(k):
+                    continue
+                row = self.cache.get_key(k)
+                miss = act if row is None else (act & ~row[7])
+                if not miss.any():
+                    continue
+                srow = sh.ex.rows.get(k)
+                if srow is not None:
+                    miss = miss & ~srow["mask"]
+                if miss.any():
+                    sh.request(k, v, miss)
 
     def _plan_spec_children(self, nodes: list[int],
                             window: "BuildPipeline"
@@ -910,7 +992,16 @@ class FrontierEngine:
         then quarantine).  ("degraded", ...) handles -- minted by the
         pipeline once the device-failure cap tripped -- skip the
         device wait AND the failure bookkeeping: the degraded engine
-        routes straight to the twin without re-failing per batch."""
+        routes straight to the twin without re-failing per batch.
+
+        Takes eng._oracle_lock (reentrant -- pipeline._resolve already
+        holds it): wait-time counter updates and the recovery
+        machinery must never interleave with the async-certify
+        waiter's."""
+        with self._oracle_lock:
+            return self._wait_or_fallback_locked(kind, handle, args)
+
+    def _wait_or_fallback_locked(self, kind: str, handle, args: tuple):
         if not (isinstance(handle, tuple) and handle
                 and handle[0] == "degraded"):
             try:
@@ -1126,6 +1217,20 @@ class FrontierEngine:
         faults_lib.fire("build.step", label=str(self.steps))
         t_step = time.perf_counter()
         self._oracle_s = 0.0
+        if self._shard is not None:
+            # Exchange maintenance: ingest peer publications, answer
+            # peer requests (on-behalf solves charge _oracle_s through
+            # _oracle_call like any other device work).
+            self._shard.tick()
+            # Request-ahead for the whole VISIBLE frontier, not just
+            # pipeline claims: small shard frontiers rarely fill a
+            # full-size lookahead batch, and a request first posted at
+            # commit time costs a full cross-shard round-trip stall
+            # per step.  Count-safe: an open node's need mask is fixed
+            # at its split (inherit entries never change until it
+            # commits), the request memo dedupes, and the owner solves
+            # each cell once regardless of when it was asked.
+            self._shard_prefetch()
         B = min(len(self.frontier), self.cfg.batch_simplices)
         nodes = [self.frontier.popleft() for _ in range(B)]
         pipe = self._pipe
@@ -1157,10 +1262,28 @@ class FrontierEngine:
         if plan is not None:
             sol, pair_out = pipe.serve(plan)
             self._merge_plan_results(plan, sol, pair_out)
+            rem = plan.get("remote")
+            if rem:
+                # Block for remotely-owned cells (sharded frontier).
+                # The full collect wall is cross-shard wait: charge it
+                # to _oracle_s exactly once (collect's own on-behalf
+                # solves already charged their share through
+                # _oracle_call, so take the max, not the sum).
+                t_rem = time.perf_counter()
+                o0 = self._oracle_s
+                self._shard.collect(rem)
+                inner = self._oracle_s - o0
+                self._oracle_s = o0 + max(
+                    time.perf_counter() - t_rem, inner)
         # Speculative child dispatch: cells the inherited-gap heuristic
         # predicts will split get their children's shared midpoint
         # dispatched NOW, before this batch's certificates run.
         pipe.speculate(nodes)
+        # Asynchronous host-certify (cfg.async_certify): hand the
+        # in-flight lookahead programs to the background waiter so
+        # their device waits overlap the certify/commit host wall
+        # below instead of serializing into the next step's wait.
+        pipe.prewait()
 
         results: dict[int, certify.CertificateResult] = {}
         stage2: list[tuple[int, int]] = []  # (node, delta')
@@ -1511,6 +1634,8 @@ class FrontierEngine:
                 m.gauge(f"build.cp_{seg}_s").set(self._cp[seg])
                 m.gauge(f"build.cp_{seg}_frac").set(
                     self._cp[seg] / denom)
+            if pipe.async_on:
+                m.gauge("build.cp_overlap_s").set(pipe.overlap_wait_s)
             rec = o.event("build.step", step=self.steps, regions=regions,
                           frontier=len(self.frontier), batch=B,
                           leaves=n_leaves, splits=n_splits,
@@ -1618,6 +1743,17 @@ class FrontierEngine:
             # stats snapshot below.
             self._pipe.cancel()
             wall = time.perf_counter() - t0
+            if self._shard is not None:
+                # Sharded epilogue (partition/shard.py): serve peer
+                # requests until every shard drains, then merge the
+                # shard trees -- every process merges identically, so
+                # callers see one global result on all shards.
+                res = self._shard.finalize(self, wall)
+                brief = {k: v for k, v in res.stats.items()
+                         if k != "per_shard"}
+                self.log.emit(done=True, **brief)
+                self.obs.event("build.done", **brief)
+                return res
             stats = self.stats_dict(wall)
             self.log.emit(done=True, **stats)
             self.obs.event("build.done", **stats)
@@ -1709,6 +1845,14 @@ class FrontierEngine:
                 self._pipe.spec_waste_frac(self.oracle.n_point_solves),
                 4),
             "device_failures": self.n_device_failures,
+            # Asynchronous host-certify economy (cfg.async_certify):
+            # device-wait seconds the background waiter absorbed while
+            # the host certified -- the overlap win the serialized
+            # cp_wait_frac no longer contains.
+            "async_certify": bool(getattr(self._pipe, "async_on",
+                                          False)),
+            "cp_overlap_s": round(
+                getattr(self._pipe, "overlap_wait_s", 0.0), 3),
             # Checkpoint wall (the one critical-path segment outside
             # the step loop); the per-segment step-wall fractions are
             # appended below when any step ran.
@@ -1738,6 +1882,11 @@ class FrontierEngine:
 
     def save_checkpoint(self, path: str) -> None:
         t_ck = time.perf_counter()
+        if self._shard is not None:
+            # Each shard owns ITS OWN frontier state: per-shard
+            # checkpoint generations, suffixed like the per-process
+            # obs streams (resume re-derives the same suffix).
+            path = f"{path}.p{self._shard.shard}"
         try:
             self._save_checkpoint(path, t_ck)
         finally:
@@ -1763,9 +1912,11 @@ class FrontierEngine:
         self._pipe.cancel()
         # Under multi-process SPMD every process runs the frontier in
         # lockstep; side effects belong to the owner (process 0) only.
+        # A SHARDED frontier is the opposite: every shard's state is
+        # distinct and every shard writes its own (suffixed) file.
         from explicit_hybrid_mpc_tpu.parallel import distributed
 
-        if not distributed.is_frontier_owner():
+        if self._shard is None and not distributed.is_frontier_owner():
             return
         snap = {
             "tree": self.tree, "roots": self.roots,
@@ -1887,6 +2038,15 @@ class FrontierEngine:
         eng.n_inherited_skips = snap.get("n_inherited_skips", 0)
         eng.n_point_skips = snap.get("n_point_skips", 0)
         eng._full_mask = np.ones(oracle.can.n_delta, dtype=bool)
+        # Sharded context BEFORE the pipeline (the pipeline's
+        # speculation gate reads it); the snapshot's frontier already
+        # holds only this shard's open nodes, so no re-filter.
+        eng._shard = None
+        if getattr(cfg, "shard_frontier", False):
+            from explicit_hybrid_mpc_tpu.partition.shard import (
+                ShardContext)
+
+            eng._shard = ShardContext.from_config(eng, cfg)
         # Fresh pipeline: in-flight state is never serialized (the
         # checkpoint cancelled it), so a resumed build starts quiescent
         # and re-plans from the restored frontier.  Pre-pipeline
@@ -1907,6 +2067,7 @@ class FrontierEngine:
                 eng.cache._d[k] = (*row, None, None)
         eng._fb_oracle = None
         eng._oracle_s = 0.0
+        eng._oracle_lock = threading.RLock()
         oracle.n_solves = snap.get("n_solves", 0)
         oracle.n_point_solves = snap.get("n_point_solves", 0)
         oracle.n_simplex_solves = snap.get("n_simplex_solves", 0)

@@ -64,6 +64,8 @@ from __future__ import annotations
 
 import collections
 import itertools
+import queue
+import threading
 import time
 import types
 
@@ -86,7 +88,7 @@ class _Program:
     point-QP cells."""
 
     __slots__ = ("kind", "handle", "args", "out", "spec", "n_cells",
-                 "n_used", "live_refs", "retired")
+                 "n_used", "live_refs", "retired", "lock", "queued")
 
     def __init__(self, kind: str, handle, args: tuple, spec: bool,
                  n_cells: int):
@@ -99,6 +101,11 @@ class _Program:
         self.n_used = 0
         self.live_refs = 0
         self.retired = False
+        # Serializes resolution between the committing thread and the
+        # async-certify background waiter (cfg.async_certify); a
+        # plain Lock is ~100 ns uncontended, noise next to a dispatch.
+        self.lock = threading.Lock()
+        self.queued = False
 
 
 class _Src:
@@ -156,7 +163,14 @@ class BuildPipeline:
         self.spec_on = (bool(getattr(cfg, "speculate", True))
                         and self.depth >= 1
                         and getattr(cfg, "eps_a", 0.0) > 0
-                        and getattr(eng.oracle, "mesh", None) is None)
+                        and getattr(eng.oracle, "mesh", None) is None
+                        # Sharded frontiers never speculate: a
+                        # mis-speculated midpoint on a shard boundary
+                        # would post exchange requests the owner then
+                        # solves for a child that never materializes --
+                        # wasted remote work AND a broken summed-
+                        # point_solves parity bar.
+                        and getattr(eng, "_shard", None) is None)
         self.window_cap = int(getattr(cfg, "dedup_window", 8192))
         # (batch node tuple, planned?) -- planned is False when the
         # full dedup window refused the tentative plan at fill time.
@@ -172,6 +186,23 @@ class BuildPipeline:
         self.spec_dropped_unwaited = 0
         self._fill_sum = 0.0
         self._fill_steps = 0
+        # Asynchronous host-certify (cfg.async_certify): a background
+        # waiter resolves in-flight NON-speculative programs while the
+        # engine certifies, so the next step's serve() finds them
+        # memoized and the serialized cp_wait share shrinks.
+        # Speculative programs are excluded on purpose: the oracle
+        # counts solves at WAIT time, and pre-waiting a speculation
+        # that gets dropped would count device work the synchronous
+        # build never counts.  Mesh oracles are excluded like the
+        # speculation gate: collective programs must resolve in the
+        # engine thread's deterministic order on every process.
+        self.async_on = (bool(getattr(cfg, "async_certify", False))
+                         and self.depth >= 1
+                         and getattr(eng.oracle, "mesh", None) is None)
+        self.overlap_wait_s = 0.0
+        self.n_overlap_resolved = 0
+        self._bg_thread: threading.Thread | None = None
+        self._bg_q: "queue.Queue[_Program | None]" | None = None
         # Wall seconds of the most recent fill() call -- the
         # "pipeline fill" segment of the engine's per-step critical-
         # path breakdown (frontier.step; measured here so lookahead
@@ -388,23 +419,163 @@ class BuildPipeline:
                                      (args[0], args[1]))
         return (*out5, None, None)
 
-    def _resolve(self, prog: _Program):
+    def _resolve(self, prog: _Program, background: bool = False):
         """Block on a program's handle (device failures retry on the
-        CPU fallback, bit-compatible); memoized."""
+        CPU fallback, bit-compatible); memoized.  Thread-safe under
+        the per-program lock: the committing thread and the async-
+        certify waiter may race to the same program, and exactly one
+        performs the wait.  Background resolution charges
+        ``overlap_wait_s`` instead of the engine's ``_oracle_s`` (the
+        overlap is the point: that wall no longer serializes a
+        step)."""
         if prog.out is not None:
             return prog.out
         eng = self.eng
-        if prog.kind == "grid":
-            prog.out = self._timed(
-                "build.wait_vertices",
-                lambda: eng._wait_or_fallback(
-                    "vertices", prog.handle, prog.args))
-        else:
-            prog.out = self._timed(
-                "build.wait_pairs",
-                lambda: self._wait_pairs(prog.handle, prog.args))
-        prog.handle = None
+        # eng._oracle_lock (an RLock) serializes this wait against
+        # BOTH the waiter thread and the engine's own synchronous
+        # oracle calls: the oracle's wait paths mutate shared counters
+        # (n_point_solves += K, the iteration ledger, obs batching)
+        # and the device-failure/degrade machinery, none of which are
+        # thread-safe -- per-program locks alone would let two
+        # DIFFERENT programs' waits interleave those read-modify-write
+        # updates and silently lose increments the bit-exact parity
+        # gates depend on.
+        with eng._oracle_lock, prog.lock:
+            if prog.out is not None:
+                return prog.out
+            if prog.kind == "grid":
+                span = "build.wait_vertices"
+
+                def fn():
+                    return eng._wait_or_fallback(
+                        "vertices", prog.handle, prog.args)
+            else:
+                span = "build.wait_pairs"
+
+                def fn():
+                    return self._wait_pairs(prog.handle, prog.args)
+            if background:
+                # No obs span off-thread (the tracer's span stack is
+                # thread-local; a background span would orphan).
+                t0 = time.perf_counter()
+                prog.out = fn()
+                self.overlap_wait_s += time.perf_counter() - t0
+                self.n_overlap_resolved += 1
+            else:
+                prog.out = self._timed(span, fn)
+            prog.handle = None
         return prog.out
+
+    # -- asynchronous host-certify (cfg.async_certify) ---------------------
+
+    def _ensure_waiter(self) -> None:
+        if self._bg_thread is not None:
+            return
+        self._bg_q = queue.Queue()
+
+        def loop():
+            while True:
+                prog = self._bg_q.get()
+                try:
+                    if prog is None:
+                        return
+                    try:
+                        self._resolve(prog, background=True)
+                    except Exception:  # tpulint: disable=silent-except -- overlap is best-effort; the foreground wait re-raises
+                        pass
+                finally:
+                    self._bg_q.task_done()
+
+        self._bg_thread = threading.Thread(
+            target=loop, daemon=True, name="ehm-async-certify")
+        self._bg_thread.start()
+
+    def prewait(self) -> None:
+        """Queue every unresolved, non-speculative in-flight program
+        for background resolution -- called by the engine right before
+        its certify/commit block, so the device waits of steps k+1..
+        overlap the host wall of step k.  A no-op unless
+        cfg.async_certify armed the waiter."""
+        if not self.async_on:
+            return
+        self._ensure_waiter()
+        seen: set[int] = set()
+        for e in self._win.values():
+            for src in itertools.chain(
+                    e.grid, *e.cells.values()):
+                prog = src.prog
+                if (prog.spec or prog.queued or prog.out is not None
+                        or id(prog) in seen):
+                    continue
+                seen.add(id(prog))
+                prog.queued = True
+                self._bg_q.put(prog)
+
+    def quiesce(self) -> None:
+        """Stop the background waiter at a safe point: PENDING queue
+        entries are dropped UN-resolved (their programs were never
+        waited, so -- like the sync build's dropped in-flight handles
+        -- the oracle never counts them; resolving them here would
+        count device work whose cells cancel() is about to discard),
+        then the one program the waiter may currently be resolving is
+        allowed to finish (a snapshot must never race a half-resolved
+        wait; that single program's wait-time counting is the at-most-
+        one-program drift async certify can add at a cancel
+        boundary)."""
+        if self._bg_q is None:
+            return
+        while True:
+            try:
+                prog = self._bg_q.get_nowait()
+            except queue.Empty:
+                break
+            if prog is not None:
+                prog.queued = False
+            self._bg_q.task_done()
+        self._bg_q.join()
+
+    def resolve_vertex(self, k: bytes, nd: int) -> dict | None:
+        """Resolve this vertex's in-flight NON-speculative coverage
+        into (nd,)-shaped row parts: {"mask","V","conv","grad","u0",
+        "z"} -- the sharded frontier's request server uses it so a
+        peer's request for a cell this shard already has ON THE DEVICE
+        waits the existing program instead of re-solving (counting is
+        unaffected: wait-time counters fire once per program, and the
+        claim's own serve() later reads the memoized result).  None
+        when nothing in flight covers the vertex."""
+        e = self._win.get(k)
+        if e is None:
+            return None
+        can = self.eng.oracle.can
+        for src in e.grid:
+            if src.prog.spec:
+                continue
+            sol = self._resolve(src.prog)
+            i = src.idx
+            return {"mask": np.ones(nd, dtype=bool), "V": sol.V[i],
+                    "conv": sol.conv[i], "grad": sol.grad[i],
+                    "u0": sol.u0[i], "z": sol.z[i]}
+        res = None
+        for d, lst in e.cells.items():
+            for src in lst:
+                if src.prog.spec:
+                    continue
+                out = self._resolve(src.prog)
+                if res is None:
+                    res = {"mask": np.zeros(nd, dtype=bool),
+                           "V": np.full(nd, np.inf),
+                           "conv": np.zeros(nd, dtype=bool),
+                           "grad": np.zeros((nd, can.n_theta)),
+                           "u0": np.zeros((nd, can.n_u)),
+                           "z": np.zeros((nd, can.nz))}
+                res["mask"][d] = True
+                res["V"][d] = out[0][src.idx]
+                res["conv"][d] = out[1][src.idx]
+                res["grad"][d] = out[2][src.idx]
+                res["u0"][d] = out[3][src.idx]
+                res["z"][d] = out[4][src.idx]
+                break
+        return res
 
     # -- authoritative serve -----------------------------------------------
 
@@ -767,7 +938,23 @@ class BuildPipeline:
         before a checkpoint serializes (so a resume can never
         re-dispatch or double-commit in-flight work) and at the end of
         a run.  Dispatched-but-unwaited programs were never counted by
-        the oracle, so solve statistics stay exact."""
+        the oracle, so solve statistics stay exact.  (Under
+        cfg.async_certify, quiesce() drops the waiter's PENDING work
+        un-resolved for the same reason; only a program mid-resolve at
+        this instant is waited-and-counted -- an at-most-one-program
+        stats drift per cancel, never a tree change.)"""
+        self.quiesce()
+        if self._bg_thread is not None:
+            # Shut the waiter down for real: a daemon thread parked in
+            # get() would otherwise pin the whole engine (tree, cache,
+            # oracle) through its closure for the life of the process
+            # -- one leaked build per async-certify run in long-lived
+            # hosts.  prewait() restarts a fresh one on demand.
+            self._bg_q.put(None)
+            self._bg_q.join()
+            self._bg_thread.join(timeout=5.0)
+            self._bg_thread = None
+            self._bg_q = None
         for k in list(self._win):
             self._pop_entry(k)
         self._claims.clear()

@@ -119,6 +119,15 @@ GATED_METRICS: dict[str, tuple] = {
     # windows never mix metric families.
     "rebuild_reuse_frac": ("higher", 0.15, 0.05),
     "rebuild_speedup": ("higher", 0.30, 0.25),
+    # Sharded-frontier multichip scaling (bench.py --multichip;
+    # partition/shard.py): single-process build wall / sharded build
+    # wall.  Higher is better; on the CPU virtual-device harness the
+    # healthy figure is ~1.0 (the shards share the host's cores --
+    # the acceptance bound is the 1/1.15 overhead cap bench.py itself
+    # enforces), so the gate gets a wide band plus absolute slack
+    # against 2-core wall noise.  Multichip rows carry no "value", so
+    # the trailing windows never mix metric families.
+    "multichip_scaling_frac": ("higher", 0.20, 0.10),
 }
 
 _ROW_EXTRAS = ("regions", "unit", "precision", "truncated",
@@ -136,7 +145,17 @@ _ROW_EXTRAS = ("regions", "unit", "precision", "truncated",
                # workload-shaped, not monotone).
                "run_id", "obs_schema_version",
                "cp_fill_frac", "cp_plan_frac", "cp_wait_frac",
-               "cp_certify_frac", "cp_other_frac", "cp_checkpoint_s")
+               "cp_certify_frac", "cp_other_frac", "cp_checkpoint_s",
+               # Multichip sharded-frontier rows (bench.py
+               # --multichip): shard topology + per-shard throughput
+               # join back to the run's per-process obs streams via
+               # run_id; the cp_wait sync-vs-async pair is the
+               # async-certify evidence (informational, not gated).
+               "n_processes", "n_devices", "shard_regions_per_s",
+               "singleproc_wall_s", "multichip_wall_s",
+               "multichip_wall_sync_s", "multichip_overhead_ok",
+               "cp_wait_frac_sync", "cp_wait_frac_async",
+               "cp_overlap_s", "async_certify")
 
 
 def summarize(bench: dict, source: str, mtime: float | None = None) -> dict:

@@ -83,6 +83,22 @@ SCHEDULES: dict[str, dict] = {
         "faults": [
             {"site": "checkpoint.write", "kind": "crash", "at": 2},
         ]},
+    # Shard-local failure isolation (PR 14): a 2-process SHARDED build
+    # (scripts/shard_launch.py) with a dead device scripted on SHARD 1
+    # ONLY -- three failures trip the device-failure cap, so shard 1
+    # must DEGRADE to its CPU twin (bit-compatible) while shard 0
+    # never sees a fault, and the merged tree must still equal the
+    # fault-free single-process build node-for-node (canonical
+    # comparison -- the sharded merge orders nodes per-subtree).
+    "sharded_device_failure": {
+        "sharded": True,
+        "fault_shard": 1,
+        "faults": [
+            {"site": "oracle.dispatch", "kind": "error", "at": 2,
+             "match": "primary"},
+            {"site": "oracle.wait", "kind": "error", "at": 2},
+            {"site": "oracle.wait", "kind": "error", "at": 4},
+        ]},
 }
 
 
@@ -164,6 +180,38 @@ def compare_trees(ref_path: str, cand_path: str) -> list[str]:
     return diffs
 
 
+def compare_trees_canonical_paths(ref_path: str, cand_path: str,
+                                  payloads: bool = False) -> list[str]:
+    """Canonical (insertion-order independent) tree comparison for
+    sharded candidates: node identity by exact vertex-matrix bytes --
+    partition/shard.py.compare_trees_canonical over the two pickles.
+    Leaf payload floats are excluded by default (a remote cell is
+    solved inside the owner's batch composition; documented last-ulp
+    pow-2-bucket caveat), the structural bar -- vertices bitwise, leaf
+    sets, statuses, commutation choices -- is identical to
+    compare_trees'."""
+    from explicit_hybrid_mpc_tpu.partition.shard import (
+        compare_trees_canonical)
+    from explicit_hybrid_mpc_tpu.partition.tree import Tree
+
+    return compare_trees_canonical(Tree.load(ref_path),
+                                   Tree.load(cand_path),
+                                   payloads=payloads)
+
+
+def run_sharded_schedule(prefix: str, plan_path: str, fault_shard: int,
+                         eps: float, batch: int,
+                         timeout_s: float) -> dict:
+    """2-process sharded build with the fault plan injected into ONE
+    shard's environment only (shard-local failure isolation)."""
+    import shard_launch
+
+    argv = _build_argv(prefix, eps, batch) + ["--no-speculate"]
+    return shard_launch.launch_sharded(
+        argv, n_processes=2, timeout_s=timeout_s,
+        env_extra_per_shard={fault_shard: {"EHM_FAULT_PLAN": plan_path}})
+
+
 def _stats(prefix: str) -> dict:
     with open(prefix + ".stats.json") as f:
         return json.load(f)
@@ -219,18 +267,28 @@ def main(argv: list[str] | None = None) -> int:
                        "process_exit": spec.get("process_exit", False),
                        "faults": spec["faults"]}, f, indent=2)
         print(f"chaos: schedule {name} ...", file=sys.stderr)
-        r = run_build(prefix, args.eps, args.batch,
-                      plan_path=plan_path,
-                      extra_argv=spec.get("extra_argv"),
-                      supervised=spec.get("supervised", False),
-                      timeout_s=args.timeout)
+        sharded = spec.get("sharded", False)
+        if sharded:
+            r = run_sharded_schedule(prefix, plan_path,
+                                     spec.get("fault_shard", 1),
+                                     args.eps, args.batch,
+                                     timeout_s=args.timeout)
+        else:
+            r = run_build(prefix, args.eps, args.batch,
+                          plan_path=plan_path,
+                          extra_argv=spec.get("extra_argv"),
+                          supervised=spec.get("supervised", False),
+                          timeout_s=args.timeout)
         row = dict(r)
+        row.pop("stderr", None)
         verdict["schedules"][name] = row
         if r["hung"]:
             failures.append(f"{name}: build HUNG (> {args.timeout}s)")
             continue
         if r["rc"] != 0:
-            failures.append(f"{name}: build exited rc={r['rc']}")
+            tail = (r.get("stderr") or [""])[-1][-500:] \
+                if sharded else ""
+            failures.append(f"{name}: build exited rc={r['rc']} {tail}")
             continue
         st = _stats(prefix)
         row["stats"] = {k: st.get(k) for k in
@@ -245,7 +303,29 @@ def main(argv: list[str] | None = None) -> int:
             failures.append(
                 f"{name}: uncertified {st.get('uncertified')} != "
                 f"reference {base_stats.get('uncertified')}")
-        diffs = compare_trees(base + ".tree.pkl", prefix + ".tree.pkl")
+        if sharded:
+            # Shard-local isolation: every injected failure landed on
+            # the faulted shard (which degraded to its CPU twin), the
+            # healthy shard saw none.
+            fs = spec.get("fault_shard", 1)
+            per = {s.get("shard"): s for s in st.get("per_shard", [])}
+            row["per_shard"] = st.get("per_shard")
+            if not per.get(fs, {}).get("device_degraded"):
+                failures.append(
+                    f"{name}: faulted shard {fs} did not degrade "
+                    f"({per.get(fs)})")
+            healthy = [s for s in per if s != fs]
+            for h in healthy:
+                if per[h].get("device_degraded") \
+                        or per[h].get("quarantined_cells"):
+                    failures.append(
+                        f"{name}: fault LEAKED to healthy shard {h} "
+                        f"({per[h]})")
+            diffs = compare_trees_canonical_paths(
+                base + ".tree.pkl", prefix + ".tree.pkl")
+        else:
+            diffs = compare_trees(base + ".tree.pkl",
+                                  prefix + ".tree.pkl")
         row["tree_diffs"] = diffs
         if diffs:
             failures.append(f"{name}: tree DIVERGED -- "

@@ -66,6 +66,16 @@ RECONCILED_COUNTERS = (
     "oracle.simplex_solves",
 )
 
+#: The --sharded subset: a sharded build partitions the LEAF/SPLIT/
+#: SOLVE work across shards (bit-exact sums -- zero duplicate solves
+#: is the tentpole bar), but each shard batches its own sub-frontier,
+#: so build.steps legitimately differs from the single-process
+#: schedule and is excluded.
+SHARDED_RECONCILED_COUNTERS = (
+    "build.leaves", "build.splits",
+    "oracle.point_solves", "oracle.simplex_solves",
+)
+
 
 def _env(plan_path: str | None = None) -> dict:
     env = dict(os.environ)
@@ -86,8 +96,9 @@ def _build_argv(out_prefix: str, eps: float, batch: int) -> list[str]:
 
 def run_build(out_prefix: str, eps: float, batch: int,
               plan_path: str | None = None, supervised: bool = False,
-              timeout_s: float = TIMEOUT_S) -> dict:
-    argv = _build_argv(out_prefix, eps, batch)
+              timeout_s: float = TIMEOUT_S,
+              extra_argv: list[str] | None = None) -> dict:
+    argv = _build_argv(out_prefix, eps, batch) + (extra_argv or [])
     if supervised:
         cmd = [sys.executable,
                os.path.join(REPO, "scripts", "supervise_build.py"),
@@ -116,12 +127,114 @@ def _stream_counters(prefix: str) -> tuple[dict, list]:
     return roll["counters"], streams
 
 
+def run_sharded_smoke(wd: str, args, verdict: dict,
+                      failures: list[str]) -> int:
+    """--sharded mode: the 2-process SHARDED flagship DI build (not a
+    supervised restart chain) must reconcile counters bit-exactly
+    with the single-process build and produce a node-for-node
+    identical tree (canonical comparison -- the merged tree orders
+    nodes per-subtree).  Speculation is off in BOTH runs: it is
+    timing-gated and disabled under sharding, and the zero-duplicate
+    bar is exact equality, not a budget."""
+    import shard_launch
+    from chaos_suite import compare_trees_canonical_paths
+
+    ref = os.path.join(wd, "straight")
+    print(f"fleet_smoke[sharded]: single-process reference "
+          f"(eps {args.eps}) ...", file=sys.stderr)
+    argv_extra = ["--no-speculate"]
+    r = run_build(ref, args.eps, args.batch, timeout_s=args.timeout,
+                  extra_argv=argv_extra)
+    verdict["reference"] = r
+    if r["rc"] != 0 or r["hung"]:
+        print(f"fleet_smoke: reference build failed ({r})",
+              file=sys.stderr)
+        return 2
+    flt = os.path.join(wd, "sharded")
+    print("fleet_smoke[sharded]: 2-process sharded build ...",
+          file=sys.stderr)
+    r = shard_launch.launch_sharded(
+        _build_argv(flt, args.eps, args.batch) + argv_extra,
+        n_processes=2, timeout_s=args.timeout)
+    verdict["sharded"] = {k: r[k] for k in
+                          ("rc", "rcs", "wall_s", "hung")}
+    if r["rc"] != 0 or r["hung"]:
+        print(f"fleet_smoke: sharded build failed ({r['rcs']}):\n"
+              + "\n".join(t[-800:] for t in r["stderr"]),
+              file=sys.stderr)
+        return 2
+
+    ref_counters, _ref_streams = _stream_counters(ref)
+    from explicit_hybrid_mpc_tpu.obs import fleet as fleet_lib
+
+    streams = fleet_lib.load_fleet(flt + ".obs.jsonl")
+    roll = fleet_lib.fleet_rollup(streams)
+    verdict["n_fleet_streams"] = len(streams)
+    if len(streams) != 2:
+        failures.append(f"expected 2 per-shard streams, got "
+                        f"{len(streams)}")
+    run_ids = {s.identity.get("run_id") for s in streams
+               if s.identity}
+    if len(run_ids) != 1:
+        failures.append(f"shard streams carry {len(run_ids)} run_ids; "
+                        "the launcher's EHM_RUN_ID should unify them")
+    recon = {}
+    for key in SHARDED_RECONCILED_COUNTERS:
+        a, b = ref_counters.get(key), roll["counters"].get(key)
+        recon[key] = {"reference": a, "sharded_sum": b}
+        if a != b:
+            failures.append(f"counter {key}: sharded sum {b} != "
+                            f"single-process {a}")
+    verdict["reconciliation"] = recon
+
+    with open(ref + ".stats.json") as f:
+        ref_stats = json.load(f)
+    with open(flt + ".stats.json") as f:
+        flt_stats = json.load(f)
+    verdict["per_shard"] = flt_stats.get("per_shard")
+    if ref_stats["regions"] != flt_stats["regions"]:
+        failures.append(f"regions {flt_stats['regions']} != reference "
+                        f"{ref_stats['regions']}")
+    if roll.get("regions_sum") != ref_stats["regions"]:
+        failures.append(f"rollup regions_sum {roll.get('regions_sum')} "
+                        f"!= reference {ref_stats['regions']}")
+    if flt_stats.get("shard_fallback_cells"):
+        failures.append(
+            f"{flt_stats['shard_fallback_cells']} remote cells hit "
+            "the local-fallback timeout (duplicate solves)")
+    diffs = compare_trees_canonical_paths(ref + ".tree.pkl",
+                                          flt + ".tree.pkl")
+    verdict["tree_diffs"] = diffs
+    if diffs:
+        failures.append("tree DIVERGED -- " + "; ".join(diffs))
+
+    rep_json = os.path.join(wd, "fleet_report.json")
+    rc = subprocess.call(
+        [sys.executable, os.path.join(REPO, "scripts", "obs_report.py"),
+         flt + ".obs.p*.jsonl", "--fleet", "--strict",
+         "--json", rep_json], env=_env(), cwd=REPO)
+    if rc != 0:
+        failures.append(f"obs_report --fleet --strict exited {rc}")
+    if not failures:
+        print(f"FLEET SMOKE (sharded) OK: 2 shards reconcile exactly "
+              f"({ref_stats['regions']} regions, "
+              f"{len(SHARDED_RECONCILED_COUNTERS)} counters bit-equal, "
+              "tree node-for-node identical)", file=sys.stderr)
+    return 0
+
+
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--eps", type=float, default=0.2,
                     help="eps_a (default 0.2 = the 392-region tier-1 "
                          "flagship; raise for a quicker smoke)")
     ap.add_argument("--batch", type=int, default=64)
+    ap.add_argument("--sharded", action="store_true",
+                    help="smoke the 2-process SHARDED flagship build "
+                         "(partition/shard.py) instead of the "
+                         "supervised-restart chain: counters must "
+                         "reconcile bit-exactly, trees node-for-node "
+                         "(canonical)")
     ap.add_argument("--timeout", type=float, default=TIMEOUT_S)
     ap.add_argument("--workdir", default=None,
                     help="keep artifacts here instead of a temp dir")
@@ -130,8 +243,26 @@ def main(argv: list[str] | None = None) -> int:
 
     wd = args.workdir or tempfile.mkdtemp(prefix="fleet_smoke.")
     os.makedirs(wd, exist_ok=True)
-    verdict: dict = {"eps": args.eps, "workdir": wd}
+    verdict: dict = {"eps": args.eps, "workdir": wd,
+                     "sharded_mode": args.sharded}
     failures: list[str] = []
+
+    if args.sharded:
+        rc = run_sharded_smoke(wd, args, verdict, failures)
+        verdict["failures"] = failures
+        if args.json_out:
+            with open(args.json_out, "w") as f:
+                json.dump(verdict, f, indent=2)
+        if not args.workdir:
+            shutil.rmtree(wd, ignore_errors=True)
+        if rc:
+            return rc
+        if failures:
+            print("FLEET SMOKE (sharded) FAILED:", file=sys.stderr)
+            for f_ in failures:
+                print("  " + f_, file=sys.stderr)
+            return 1
+        return 0
 
     ref = os.path.join(wd, "straight")
     print(f"fleet_smoke: single-process reference build "

@@ -504,12 +504,29 @@ def fleet_report(streams) -> dict:
         cp = {seg: cp_s.get(seg, 0.0) / step_total
               for seg in ("fill", "plan", "wait", "certify", "other")}
         cp["checkpoint_s"] = cp_s.get("checkpoint", 0.0)
+    # Sharded-frontier evidence: every stream came from a genuinely
+    # multi-process run.  A supervised RESTART CHAIN also has several
+    # streams, but each is a single-process session (process_count 1)
+    # whose partial snapshots must NOT be summed into a "total".
+    sharded = bool(streams) and all(
+        ((s.identity or {}).get("process_count") or 1) > 1
+        for s in streams)
     return {"n_streams": len(streams),
             "run_ids": roll["run_ids"],
+            "sharded": sharded,
             "rollup": {"counters": roll["counters"],
                        "regions": roll["regions"],
+                       # Sharded-frontier builds certify disjoint
+                       # subtrees: the per-shard SUM is their total.
+                       "regions_sum": roll.get("regions_sum"),
                        "histograms": {k: histogram_row(h) for k, h in
                                       roll["histograms"].items()}},
+            # Per-shard cp fractions (obs/fleet.py rollup rows): a
+            # straggling shard's own profile, invisible in the summed
+            # fold above.
+            "per_shard_cp": {sid: row.get("cp") or {}
+                             for sid, row in
+                             (roll.get("per_shard") or {}).items()},
             "critical_path": cp,
             "straggler": fleet_lib.straggler_report(streams),
             "issues": fleet_lib.strict_issues(streams),
@@ -542,7 +559,19 @@ def render_fleet(rep: dict) -> str:
                           sorted(headline.items())))
     if roll.get("regions") is not None:
         ln.append(f"rollup regions (max across shards): "
-                  f"{int(roll['regions'])}")
+                  f"{int(roll['regions'])}"
+                  + (f", sum {int(roll['regions_sum'])} (sharded-"
+                     "frontier total)"
+                     if rep.get("sharded")
+                     and roll.get("regions_sum") is not None
+                     and roll["regions_sum"] != roll["regions"]
+                     else ""))
+    for sid, cp in sorted((rep.get("per_shard_cp") or {}).items()):
+        if cp:
+            ln.append(f"  shard {sid} critical path: " + " / ".join(
+                f"{seg} {100 * cp[seg]:.0f}%" for seg in
+                ("fill", "plan", "wait", "certify", "other")
+                if cp.get(seg) is not None))
     cp = rep.get("critical_path")
     if cp:
         ln.append("fleet critical path: " + " / ".join(

@@ -1,0 +1,191 @@
+"""Localhost launcher for multi-process sharded-frontier builds.
+
+The sharded frontier (partition/shard.py; `main.py --shard-frontier`)
+expects one process per shard, rendezvousing through jax.distributed.
+On a pod that is the platform launcher's job; on a laptop / CI host
+this helper spawns N copies of ``python -m explicit_hybrid_mpc_tpu.main``
+over localhost CPU with the coordinator env JAX reads
+(JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES / JAX_PROCESS_ID), a
+per-process virtual-device count, and an optionally per-shard fault
+plan (the chaos suite's shard-local device-failure schedule injects
+into ONE shard only).
+
+Shared by scripts/chaos_suite.py, scripts/fleet_smoke.py --sharded,
+and bench.py --multichip; also usable standalone::
+
+    python scripts/shard_launch.py -n 2 -- -e double_integrator \
+        -a 0.5 --backend cpu --problem-arg N=3 -o /tmp/shardbuild
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("localhost", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def shard_env(base: dict, port: int, pid: int, n: int,
+              local_devices: int = 1,
+              compile_cache: bool = True) -> dict:
+    """Environment for shard `pid` of `n` on localhost CPU.
+
+    compile_cache=False drops the persistent XLA cache entirely --
+    bench.py --multichip uses it so the single-process reference and
+    the sharded legs pay SYMMETRIC compile walls (jax's persistent
+    cache does not serve multi-process clients on this version, and a
+    cached reference vs uncached shards would misread as sharding
+    overhead)."""
+    env = dict(base)
+    # APPEND to PYTHONPATH (never clobber -- verify SKILL.md gotcha).
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_COORDINATOR_ADDRESS"] = f"localhost:{port}"
+    env["JAX_NUM_PROCESSES"] = str(n)
+    env["JAX_PROCESS_ID"] = str(pid)
+    env["JAX_PLATFORMS"] = "cpu"
+    # Pin the per-process virtual device count, replacing whatever the
+    # parent set (the pytest conftest exports 8).
+    flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                   env.get("XLA_FLAGS", ""))
+    env["XLA_FLAGS"] = (
+        flags + f" --xla_force_host_platform_device_count"
+                f"={local_devices}").strip()
+    # XLA:CPU AOT cache entries are host- and device-count-specific:
+    # re-key the persistent compile cache for the CHILD's client shape
+    # (bench.cpu_cache_dir's scheme; the parent's dir would trip the
+    # machine-type rejection).  All shards -- and bench --multichip's
+    # single-process reference -- share one warm cache, so repeated
+    # captures do not re-measure compilation.  bench.py is jax-free at
+    # import by contract; fall back to dropping the cache if anything
+    # about that changes underfoot.
+    if not compile_cache:
+        env.pop("JAX_COMPILATION_CACHE_DIR", None)
+        return env
+    try:
+        if REPO not in sys.path:
+            sys.path.insert(0, REPO)
+        import bench as _bench
+
+        env["JAX_COMPILATION_CACHE_DIR"] = os.path.join(
+            _bench.CACHE_DIR,
+            f"cpu-{_bench.host_cpu_fingerprint()}-d{local_devices}")
+        # Every program qualifies: the default 1 s floor skips most of
+        # the DI ladder's sub-second compiles, and in multi-process
+        # mode only process 0 writes -- a floor on top of that leaves
+        # the other shards recompiling every launch.
+        env["JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"] = "0"
+        env["JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES"] = "0"
+    except Exception:  # tpulint: disable=silent-except -- cache is an optimization
+        env.pop("JAX_COMPILATION_CACHE_DIR", None)
+    return env
+
+
+def launch_sharded(build_argv: list[str], n_processes: int = 2,
+                   local_devices: int = 1,
+                   timeout_s: float = 900.0,
+                   env_extra_per_shard: dict | None = None,
+                   compile_cache: bool = True,
+                   cwd: str = REPO) -> dict:
+    """Run ``main.py <build_argv> --shard-frontier`` as `n_processes`
+    rendezvousing shards; returns {"rc": worst rc, "rcs": [...],
+    "wall_s": float, "hung": bool, "stderr": [tails]}.
+
+    env_extra_per_shard: {shard_index: {ENV: VALUE}} -- e.g. a fault
+    plan injected into one shard only."""
+    port = free_port()
+    argv = list(build_argv)
+    if "--shard-frontier" not in argv:
+        argv = argv + ["--shard-frontier"]
+    # One run_id for the whole shard set (obs/clock.py: EHM_RUN_ID
+    # wins), so the N per-process streams join as one fleet in
+    # obs_report/fleet_smoke -- same contract supervise_build.py
+    # applies to restart chains.
+    import uuid
+
+    run_id = os.environ.get("EHM_RUN_ID") or uuid.uuid4().hex
+    procs, errfiles = [], []
+    t0 = time.time()
+    for i in range(n_processes):
+        env = shard_env(os.environ, port, i, n_processes,
+                        local_devices=local_devices,
+                        compile_cache=compile_cache)
+        env["EHM_RUN_ID"] = run_id
+        for k, v in (env_extra_per_shard or {}).get(i, {}).items():
+            env[k] = v
+        # Child output goes to temp FILES, never pipes: the launcher
+        # waits the shards sequentially, and a not-yet-waited shard
+        # that fills a ~64 KB pipe (jax warnings, fault-retry spew
+        # under the chaos schedules) would block mid-write, stop
+        # serving the exchange, and deadlock the whole shard set
+        # until the timeout.
+        # Binary mode: the tail read below seeks to an arbitrary byte
+        # offset, which a text-mode wrapper cannot do (and a seek
+        # landing inside a multi-byte UTF-8 char would raise).
+        ef = tempfile.TemporaryFile(mode="w+b")
+        errfiles.append(ef)
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "explicit_hybrid_mpc_tpu.main"]
+            + argv,
+            cwd=cwd, env=env, stdout=subprocess.DEVNULL, stderr=ef))
+    rcs, tails, hung = [], [], False
+    for p, ef in zip(procs, errfiles):
+        left = max(1.0, timeout_s - (time.time() - t0))
+        try:
+            p.wait(timeout=left)
+            rcs.append(p.returncode)
+        except subprocess.TimeoutExpired:
+            hung = True
+            for q in procs:
+                q.kill()
+            p.wait()
+            rcs.append(-9)
+        ef.seek(0, os.SEEK_END)
+        size = ef.tell()
+        ef.seek(max(0, size - 2000))
+        tails.append(ef.read().decode("utf-8", errors="replace"))
+        ef.close()
+    rc = -9 if hung else max((abs(r) for r in rcs), default=0)
+    return {"rc": rc if any(rcs) or hung else 0, "rcs": rcs,
+            "wall_s": round(time.time() - t0, 2), "hung": hung,
+            "stderr": tails}
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("-n", "--processes", type=int, default=2)
+    ap.add_argument("--local-devices", type=int, default=1)
+    ap.add_argument("--timeout", type=float, default=900.0)
+    ap.add_argument("build_argv", nargs=argparse.REMAINDER,
+                    help="main.py build args after `--`")
+    args = ap.parse_args(argv)
+    build = [a for a in args.build_argv if a != "--"]
+    if not build:
+        ap.error("pass the main.py build argv after --")
+    r = launch_sharded(build, n_processes=args.processes,
+                       local_devices=args.local_devices,
+                       timeout_s=args.timeout)
+    for i, tail in enumerate(r["stderr"]):
+        if r["rcs"][i] != 0:
+            print(f"--- shard {i} (rc {r['rcs'][i]}) ---\n{tail}",
+                  file=sys.stderr)
+    print(f"sharded launch: rcs={r['rcs']} wall={r['wall_s']}s "
+          f"hung={r['hung']}", file=sys.stderr)
+    return 0 if r["rc"] == 0 else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -8,7 +8,14 @@ processes agree with each other and with a single-process build --
 proving the frontier's multi-process staging path (SURVEY.md section 6.8)
 end to end without a cluster.
 
-Usage: python tests/_mp_worker.py PORT PROCESS_ID NUM_PROCESSES
+Usage: python tests/_mp_worker.py PORT PROCESS_ID NUM_PROCESSES [MODE]
+
+MODE 'build' (default) runs the lockstep mesh build above; MODE
+'stage_permuted' instead checks `distributed.stage_batch` on a mesh
+built from an INTERLEAVED global device list -- each process's rows
+are then non-contiguous, `local_contiguous_block` must reject the
+fast path, and the callback fallback must still stage every shard's
+exact rows (the PR-14 contiguity satellite).
 """
 
 import json
@@ -18,6 +25,7 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 port, pid, nproc = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+mode = sys.argv[4] if len(sys.argv) > 4 else "build"
 
 import re  # noqa: E402
 
@@ -51,6 +59,29 @@ from explicit_hybrid_mpc_tpu.parallel import (distributed,  # noqa: E402
 from explicit_hybrid_mpc_tpu.partition.frontier import (  # noqa: E402
     build_partition)
 from explicit_hybrid_mpc_tpu.problems.registry import make  # noqa: E402
+
+if mode == "stage_permuted":
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    # Interleave the two processes' devices on the batch axis: local
+    # rows are then non-contiguous and the fast
+    # make_array_from_process_local_data path is INVALID.
+    devs = sorted(jax.devices(), key=lambda d: (d.id % 4, d.process_index))
+    mesh = make_mesh((4 * nproc, 1), devices=devs)
+    sharding = NamedSharding(mesh, P("batch"))
+    x = np.arange(16 * nproc * 3, dtype=np.float64).reshape(-1, 3)
+    idx_map = sharding.addressable_devices_indices_map(x.shape)
+    block = distributed.local_contiguous_block(idx_map, x.shape)
+    arr = distributed.stage_batch(sharding, x)
+    ok = True
+    for shard in arr.addressable_shards:
+        want = x[shard.index]
+        ok &= bool(np.array_equal(np.asarray(shard.data), want))
+    print(json.dumps({"pid": pid, "mode": mode, "ok": ok,
+                      "contiguous_block": block,
+                      "n_local_shards": len(idx_map)}), flush=True)
+    sys.exit(0)
 
 prob = make("double_integrator", N=3, theta_box=1.5)
 mesh = make_mesh((4 * nproc, 1))  # batch axis over ALL processes' devices

@@ -66,6 +66,71 @@ def test_two_process_build_matches_single_process():
     assert by_pid[0]["max_depth"] == ref.stats["max_depth"]
 
 
+def test_local_contiguous_block_predicate():
+    """The explicit stage_batch fast-path predicate: dim-0-only,
+    equal-sized, gap-free runs pass; every other layout -- permuted
+    device order, trailing-dim sharding, ragged blocks -- must route
+    to the callback fallback (returns None)."""
+    from explicit_hybrid_mpc_tpu.parallel.distributed import (
+        local_contiguous_block)
+
+    shape = (8, 4)
+    ok = {0: (slice(0, 2), slice(None)), 1: (slice(2, 4), slice(None))}
+    assert local_contiguous_block(ok, shape) == (0, 4)
+    full_stop = {0: (slice(0, 4), slice(0, 4)),
+                 1: (slice(4, 8), slice(0, 4))}
+    assert local_contiguous_block(full_stop, shape) == (0, 8)
+    # Interleaved local rows (permuted global device order).
+    gap = {0: (slice(0, 2), slice(None)), 1: (slice(4, 6), slice(None))}
+    assert local_contiguous_block(gap, shape) is None
+    # REPLICATED blocks (a (batch, delta) mesh under P("batch"): every
+    # local delta-axis device holds the same dim-0 slice) stay on the
+    # fast path -- duplicates are replication, not overlap.
+    repl = {0: (slice(0, 4), slice(None)), 1: (slice(0, 4), slice(None)),
+            2: (slice(4, 8), slice(None)), 3: (slice(4, 8), slice(None))}
+    assert local_contiguous_block(repl, shape) == (0, 8)
+    # Trailing-dim sharding: the local block is NOT a dim-0 slice of
+    # the host-global array (the old heuristic could pass this).
+    trailing = {0: (slice(0, 8), slice(0, 2)),
+                1: (slice(0, 8), slice(2, 4))}
+    assert local_contiguous_block(trailing, shape) is None
+    # Ragged per-device blocks.
+    ragged = {0: (slice(0, 3), slice(None)), 1: (slice(3, 4), slice(None))}
+    assert local_contiguous_block(ragged, shape) is None
+    # Strided slices never qualify.
+    strided = {0: (slice(0, 8, 2), slice(None))}
+    assert local_contiguous_block(strided, shape) is None
+    assert local_contiguous_block({}, shape) is None
+
+
+def test_two_process_stage_batch_permuted_mesh():
+    """Multi-process semantics of the contiguity fix: a mesh built
+    from an interleaved global device list gives every process
+    non-contiguous local rows; stage_batch must reject the fast path
+    (contiguous_block None) and the callback fallback must stage every
+    shard's exact rows."""
+    port = _free_port()
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    procs = [subprocess.Popen(
+        [sys.executable, os.path.join("tests", "_mp_worker.py"),
+         str(port), str(i), "2", "stage_permuted"],
+        cwd=REPO, env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True) for i in range(2)]
+    for p in procs:
+        try:
+            out, err = p.communicate(timeout=600)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("permuted-mesh worker timed out")
+        assert p.returncode == 0, f"worker failed:\n{err[-2000:]}"
+        rec = json.loads(out.strip().splitlines()[-1])
+        assert rec["ok"], rec
+        assert rec["contiguous_block"] is None, rec
+        assert rec["n_local_shards"] == 4
+
+
 def test_stage_batch_single_process_roundtrip():
     """stage_batch/stage_replicated: single-process path is a device_put
     that the mesh solver consumes unchanged."""

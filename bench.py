@@ -52,6 +52,7 @@ import hashlib
 import json
 import os
 import platform as host_platform
+import shutil
 import subprocess
 import sys
 import tempfile
@@ -999,6 +1000,153 @@ def run_rebuild(result: dict, monitor=None) -> None:
             log(f"nudge rebuild skipped: {e!r}")
 
 
+def run_drift_walk(result: dict, monitor=None) -> None:
+    """``bench.py --drift-walk``: the continuous-rebuild lifecycle
+    benchmark (explicit_hybrid_mpc_tpu/lifecycle/; docs/lifecycle.md).
+
+    Protocol: cold-build the nominal problem ONCE (also the compile
+    warmup -- a long-running daemon's steady state never pays cold
+    compiles per revision), seed it into a live ``RebuildService``
+    with an in-process serving registry, then drive a K-step
+    (BENCH_DRIFT_K, default 20) combined eps/plant drift walk through
+    the daemon: every revision warm-rebuilds chained on the previous
+    generation (no disk round-trip), publishes DELTA-compressed
+    artifacts, and hot-swaps the registry.  Reports:
+
+    - ``staleness_p99_s`` / ``staleness_p50_s``: end-to-end revision
+      observed -> new controller live (gated lower-is-better);
+    - ``delta_bytes_frac``: mean delta-artifact bytes / applied full
+      artifact bytes (gated lower-is-better);
+    - ``reuse_fracs`` + ``reuse_decay`` (running min) per generation,
+      and ``excl_events_trajectory`` -- the PR-10 ledger-pruning
+      evidence: chained rebuilds must keep the fact ledger BOUNDED
+      (a pruning regression shows as monotone ledger growth here long
+      before it shows in wall time).
+
+    Default problem: the hybrid inverted_pendulum at a small tier-1
+    box (the ledger is empty on pure mp-QP problems; drifting the
+    pole strength ``a`` exercises Farkas re-verification).  Env:
+    BENCH_DRIFT_K / BENCH_DRIFT_EPS / BENCH_DRIFT_FRAC /
+    BENCH_DRIFT_EPS_FRAC / BENCH_DRIFT_ARG / BENCH_PROBLEM."""
+    platform = choose_backend(result)
+    if monitor is not None:
+        monitor.start()
+    on_acc = platform != "cpu"
+
+    from explicit_hybrid_mpc_tpu import obs as obs_lib
+    from explicit_hybrid_mpc_tpu.config import PartitionConfig
+    from explicit_hybrid_mpc_tpu.lifecycle import (DriftSource,
+                                                   LifecycleConfig,
+                                                   RebuildService)
+    from explicit_hybrid_mpc_tpu.partition.frontier import build_partition
+    from explicit_hybrid_mpc_tpu.problems.registry import make, names
+
+    K = int(os.environ.get("BENCH_DRIFT_K", "20"))
+    eps = float(os.environ.get("BENCH_DRIFT_EPS", "0.6"))
+    drift_frac = float(os.environ.get("BENCH_DRIFT_FRAC", "0.03"))
+    eps_frac = float(os.environ.get("BENCH_DRIFT_EPS_FRAC", "0.05"))
+    batch = int(os.environ.get("BENCH_BATCH", "256" if on_acc else "64"))
+    # Problem resolution BEFORE the args/drift-arg choice: the
+    # pendulum-specific tier-1 box and the pole-strength walk apply
+    # only to the pendulum -- a BENCH_PROBLEM override gets that
+    # problem's constructor defaults and a u_max walk unless
+    # BENCH_DRIFT_ARG names something else.
+    problem_name = os.environ.get("BENCH_PROBLEM") or (
+        "inverted_pendulum" if "inverted_pendulum" in names()
+        else "double_integrator")
+    if problem_name == "inverted_pendulum":
+        problem_args = (("N", 2), ("theta_box", (0.25, 0.6)))
+        default_arg = "a"
+    else:
+        problem_args = ()
+        default_arg = "u_max"
+    drift_arg = os.environ.get("BENCH_DRIFT_ARG", default_arg)
+    result["metric"] = (
+        f"lifecycle drift-walk staleness/delta ({problem_name}, K={K}, "
+        f"{drift_arg} walk {drift_frac:g} + eps walk {eps_frac:g}, "
+        f"{platform})")
+
+    problem = make(problem_name, **dict(problem_args))
+    cfg = PartitionConfig(
+        problem=problem_name, problem_args=problem_args, eps_a=eps,
+        backend="device" if on_acc else "cpu", batch_simplices=batch)
+    log(f"nominal cold build (eps {eps:g}, also the compile warmup)...")
+    t0 = time.time()
+    prior = build_partition(problem, cfg)
+    log(f"nominal: {prior.stats['regions']} regions, "
+        f"{len(prior.tree.excl_events)} ledger events, "
+        f"{time.time() - t0:.1f}s")
+    result.update(drift_prior_regions=prior.stats["regions"],
+                  drift_prior_excl_events=len(prior.tree.excl_events))
+
+    from explicit_hybrid_mpc_tpu.serve.registry import ControllerRegistry
+
+    obs = obs_lib.Obs("jsonl")  # in-memory stream: metrics only
+    registry = ControllerRegistry(obs=obs)
+    wd = tempfile.mkdtemp(prefix="bench_drift.")
+    source = DriftSource(
+        problem_name, problem_args=problem_args, controller="drift",
+        eps_a=eps, drift_arg=drift_arg, drift_frac=drift_frac,
+        eps_frac=eps_frac, n_revisions=K, probe_T=10, seed=11)
+    svc = RebuildService(
+        source, cfg,
+        cfg=LifecycleConfig(artifacts_root=wd, sla_s=0.0),
+        registry=registry, prior={"drift": prior}, obs=obs)
+    source.gate = (lambda: len(svc.generations) + svc.n_failures
+                   >= source.n_emitted)
+    log(f"drift walk: {K} revisions through the live daemon...")
+    budget = deadline() - time.time() - 60.0
+    with svc:
+        done = svc.wait_idle(timeout=max(60.0, budget),
+                             target_generations=K)
+    summary = svc.summary()
+    if not done:
+        result["drift_truncated"] = True
+        log(f"drift walk truncated at {summary['generations']}/{K} "
+            f"generations (budget {budget:.0f}s)")
+    if svc.worker_error is not None:
+        raise RuntimeError(f"drift worker crashed: {svc.worker_error}")
+    if summary["failures"]:
+        raise RuntimeError(
+            f"{summary['failures']} rebuild failure(s) in the walk")
+    if not summary["generations"]:
+        raise RuntimeError("drift walk produced no generations")
+    obs.close()
+    shutil.rmtree(wd, ignore_errors=True)
+
+    excl = summary["excl_events"]
+    result.update(
+        staleness_p99_s=summary["staleness_p99_s"],
+        staleness_p50_s=summary["staleness_p50_s"],
+        delta_bytes_frac=summary["delta_bytes_frac"],
+        drift_generations=summary["generations"],
+        reuse_fracs=summary["reuse_fracs"],
+        reuse_decay=summary["reuse_decay"],
+        excl_events_trajectory=excl,
+        sla_misses=0,
+        revisions_superseded=0,
+        delta_publishes=summary["delta_publishes"],
+        full_publishes=summary["full_publishes"],
+        regions=svc.generations[-1].get("regions"),
+        # The PR-10 bounded-chain verdict: the chained ledger must not
+        # grow monotonically past a small multiple of the nominal
+        # build's (dead events are pruned per rebuild, duplicates
+        # collapse) -- recorded so the capture itself carries the
+        # claim it proves.
+        ledger_bounded=bool(
+            max(excl) <= 2 * max(len(prior.tree.excl_events), 1) + 64)
+        if excl else None,
+        metrics=obs.metrics.snapshot() if obs.enabled else None,
+    )
+    log(f"drift walk: {summary['generations']} generations, staleness "
+        f"p50/p99 {summary['staleness_p50_s']}/"
+        f"{summary['staleness_p99_s']}s, delta bytes frac "
+        f"{summary['delta_bytes_frac']}, reuse decay "
+        f"{summary['reuse_decay'][:3]}..{summary['reuse_decay'][-1:]}"
+        f", ledger {excl[0] if excl else '-'} -> "
+        f"{excl[-1] if excl else '-'}")
+
+
 def large_l_metrics(result: dict, obs=None) -> None:
     """BENCH_LARGE_DEPTH (0 disables) controls the synthetic tree depth
     (leaves = p! * 2**depth over the unit box); BENCH_LARGE_P the
@@ -1314,6 +1462,11 @@ def main(argv: list[str] | None = None) -> int:
     # the bench_gate windows never mix it with build rows.
     multichip_mode = ("--multichip" in argv
                       or os.environ.get("BENCH_MULTICHIP") == "1")
+    # --drift-walk (or BENCH_DRIFT=1): the continuous-rebuild
+    # lifecycle capture.  Rows carry staleness_p99_s/delta_bytes_frac
+    # and NO "value", so the bench_gate windows never mix families.
+    drift_mode = ("--drift-walk" in argv
+                  or os.environ.get("BENCH_DRIFT") == "1")
     if rebuild_mode:
         result: dict = {"metric": "warm-rebuild reuse/speedup",
                         "rebuild_reuse_frac": None,
@@ -1321,6 +1474,9 @@ def main(argv: list[str] | None = None) -> int:
     elif multichip_mode:
         result = {"metric": "multichip sharded-frontier scaling",
                   "multichip_scaling_frac": None}
+    elif drift_mode:
+        result = {"metric": "lifecycle drift-walk staleness/delta",
+                  "staleness_p99_s": None, "delta_bytes_frac": None}
     else:
         result = {"metric": "offline regions/sec", "value": None,
                   "unit": "regions/s", "vs_baseline": None}
@@ -1344,6 +1500,8 @@ def main(argv: list[str] | None = None) -> int:
             run_rebuild(result, monitor)
         elif multichip_mode:
             run_multichip(result, monitor)
+        elif drift_mode:
+            run_drift_walk(result, monitor)
         else:
             run(result, monitor)
     except BaseException as e:
@@ -1381,7 +1539,8 @@ def main(argv: list[str] | None = None) -> int:
         hist_path = os.environ.get("BENCH_HISTORY")
         produced = (result.get("value") is not None
                     or result.get("rebuild_speedup") is not None
-                    or result.get("multichip_scaling_frac") is not None)
+                    or result.get("multichip_scaling_frac") is not None
+                    or result.get("staleness_p99_s") is not None)
         if produced and hist_path != "":
             try:
                 sys.path.insert(0, os.path.join(

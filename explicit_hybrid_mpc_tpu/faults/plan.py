@@ -36,6 +36,12 @@ prose version):
                     registry serving the old version)
 ``serve.batch``     inside the scheduler's leased batch evaluation (a
                     worker dying mid-batch must not pin the lease)
+``lifecycle.revision``  a rebuild-daemon worker picking up an observed
+                    revision (lifecycle/service.py; label =
+                    ``controller#seq`` -- revision-storm chaos)
+``lifecycle.publish_delta``  between the delta artifact landing on
+                    disk and the registry swap (a crash here must
+                    leave the OLD version serving, node-for-node)
 ==================  ====================================================
 
 Kinds:
@@ -79,7 +85,7 @@ SITES = (
     "oracle.call", "oracle.dispatch", "oracle.wait", "oracle.fallback",
     "build.step", "checkpoint.write", "checkpoint.written",
     "artifact.written", "rebuild.sweep", "registry.publish",
-    "serve.batch",
+    "serve.batch", "lifecycle.revision", "lifecycle.publish_delta",
 )
 
 

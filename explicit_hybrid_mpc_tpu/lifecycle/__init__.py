@@ -1,0 +1,33 @@
+"""Continuous rebuild lifecycle: plant-drift watch -> SLA-scheduled
+warm rebuild -> delta-compressed publish -> fleet hot-swap.
+
+The offline tree is a certificate for ONE problem revision; the moment
+the plant drifts, the production story needs a loop nobody has to run
+by hand.  This package chains the existing subsystems into that loop:
+
+- ``revision.py``: the revision stream -- a ``RevisionSource``
+  abstraction with a simulated plant-drift driver (``DriftSource``,
+  built on ``sim/simulator.py`` + ``problems/registry.py``) and a
+  JSONL file source for tests/external watchers;
+- ``service.py``: the supervised daemon (``RebuildService``) that
+  schedules warm rebuilds (partition/rebuild.py) under a wall-clock
+  SLA with priority + coalescing, publishes each generation, and
+  hot-swaps it into a ``serve.ControllerRegistry`` while traffic
+  flows;
+- ``delta.py``: delta-compressed serving artifacts -- only the
+  invalidated/new leaf rows plus a base-version provenance pointer,
+  applied server-side so replicas sync in O(changed), not O(tree);
+- ``cli.py``: the ``main.py serve-rebuild`` surface
+  (scripts/rebuild_service.py is the standalone wrapper).
+
+docs/lifecycle.md is the prose spec (revision sources, SLA semantics,
+delta format, staleness metric definitions).
+"""
+
+from explicit_hybrid_mpc_tpu.lifecycle.delta import (  # noqa: F401
+    DeltaMismatch, apply_delta, delta_size_bytes, write_delta_artifact)
+from explicit_hybrid_mpc_tpu.lifecycle.revision import (  # noqa: F401
+    DriftSource, FileRevisionSource, Revision, RevisionSource,
+    plant_divergence)
+from explicit_hybrid_mpc_tpu.lifecycle.service import (  # noqa: F401
+    LifecycleConfig, RebuildService)

@@ -262,6 +262,14 @@ def main(argv: list[str] | None = None) -> int:
         from explicit_hybrid_mpc_tpu.serve.cli import serve_main
 
         return serve_main(argv[1:])
+    if argv and argv[0] == "serve-rebuild":
+        # The continuous rebuild daemon (lifecycle/; docs/lifecycle.md)
+        # dispatches the same way: its flags are service-scoped, not
+        # the build parser's.
+        from explicit_hybrid_mpc_tpu.lifecycle.cli import (
+            serve_rebuild_main)
+
+        return serve_rebuild_main(argv[1:])
     # `rebuild` is sugar over the build surface: same parser, --from
     # required (docs/perf.md "Incremental warm rebuild").
     rebuild_cmd = bool(argv) and argv[0] == "rebuild"

@@ -60,6 +60,18 @@ Rule schema (all values floats; 0 disables a threshold rule):
 ``min_rebuild_leaves``     prior-leaf volume floor for the rule above
                            (a tiny prior legitimately invalidates
                            wholesale)
+``max_staleness_s``        continuous-rebuild staleness ceiling in
+                           wall seconds (lifecycle.staleness_p99_s
+                           gauge, lifecycle/service.py; volume-gated
+                           on the lifecycle.rebuilds counter) ->
+                           ``health.staleness`` (warn); 0 = off (the
+                           budget is deployment-specific, like
+                           ``serve_p99_us``).  The daemon also emits
+                           its own per-generation ``health.staleness``
+                           SLA-miss events, which any monitor ADOPTS;
+                           this rule is the external-tailer
+                           (obs_watch) complement reading the rolling
+                           gauge
 ``max_quarantine_frac``    quarantined cells (build.quarantined_cells,
                            faults/policy.py poison-cell quarantine) as
                            a fraction of all solved point+simplex
@@ -117,6 +129,7 @@ DEFAULT_RULES: dict[str, float] = {
     "fallback_frac": 0.25,
     "min_rebuild_reuse": 0.2,
     "min_rebuild_leaves": 500.0,
+    "max_staleness_s": 0.0,
     "max_quarantine_frac": 0.02,
     # Fleet-level rules (obs/fleet.py FleetMonitor; single-stream
     # monitors carry but never evaluate them, so one validated rule
@@ -391,6 +404,21 @@ class HealthMonitor:
                        "-- check the prior artifact's provenance stamp "
                        "(a drifted problem hash makes every "
                        "certificate fail)")
+
+        # Continuous-rebuild staleness (lifecycle/service.py): the
+        # rolling p99 of revision-observed -> new-controller-live.
+        # Volume-gated on at least one completed rebuild (the gauge
+        # is meaningless before the first generation lands).
+        lim = self.rules["max_staleness_s"]
+        stale = gauges.get("lifecycle.staleness_p99_s")
+        if lim > 0 and stale is not None \
+                and counters.get("lifecycle.rebuilds", 0) >= 1 \
+                and stale > lim:
+            self._fire("staleness", "warn", round(stale, 3), lim,
+                       f"rebuild staleness p99 {stale:.1f}s "
+                       f"(> {lim:g}s): revisions are going live "
+                       "slower than the SLA -- the daemon is falling "
+                       "behind plant drift")
 
         # Quarantine storm (faults/policy.py): poison-cell quarantine
         # exists so ONE unrecoverable batch cannot kill a campaign --

@@ -75,7 +75,6 @@ controller name (two-epoch handoff, docs/serving.md).
 from __future__ import annotations
 
 import os
-import pickle
 import time
 
 import numpy as np
@@ -106,10 +105,16 @@ class RebuildResult(PartitionResult):
 def _load_prior(prior) -> tuple[Tree, dict, str]:
     """(prior tree, prior VertexCache rows or {}, source kind).
 
-    Accepts a Tree instance, a tree pickle path (main.py's
-    PREFIX.tree.pkl), a build-checkpoint path (PREFIX.ckpt.pkl -- its
-    cache rows become warm-start donors), or an already-loaded
-    checkpoint dict."""
+    Accepts a PartitionResult (the immediately-preceding build/rebuild,
+    chained in memory by the continuous-rebuild daemon -- no disk
+    round-trip per generation), a Tree instance, a tree pickle path
+    (main.py's PREFIX.tree.pkl), a build-checkpoint path
+    (PREFIX.ckpt.pkl -- its cache rows become warm-start donors), or an
+    already-loaded checkpoint dict.  (A serve-registry version is NOT
+    accepted: it carries only the flat leaf table, no tree structure --
+    keep the PartitionResult next to what you publish.)"""
+    if isinstance(prior, PartitionResult):
+        return prior.tree, {}, "result"
     if isinstance(prior, Tree):
         return prior, {}, "tree"
     if isinstance(prior, dict):
@@ -366,10 +371,13 @@ def warm_rebuild(problem, cfg: PartitionConfig, prior,
     new_stamp = prov.build_stamp(problem, cfg)
     stamp_diffs = prov.diff_stamps(prior_stamp, new_stamp)
 
-    # Bit-identical structure transfer: the pickle round-trip re-derives
-    # every vertex matrix from the roots with the exact bisection
-    # arithmetic (tree.py __setstate__), and normalizes legacy layouts.
-    new_tree: Tree = pickle.loads(pickle.dumps(prior_tree))
+    # Bit-identical structure transfer: columnar copy (Tree.clone; a
+    # prior loaded from disk was already normalized + vertex-rederived
+    # by __setstate__, and an in-memory prior is columnar by
+    # construction -- the old pickle.dumps round-trip serialized
+    # O(tree) bytes per generation in the rebuild daemon's hot loop
+    # for a copy the columns give directly).
+    new_tree: Tree = prior_tree.clone()
     new_tree.provenance = new_stamp
 
     eng = FrontierEngine.resume(

@@ -462,6 +462,44 @@ class Tree:
                 node = int(ri)
         return node
 
+    def clone(self) -> "Tree":
+        """Bit-identical deep copy WITHOUT a pickle round-trip.
+
+        ``warm_rebuild`` transfers a prior tree by copying it; for
+        priors loaded from disk the pickle round-trip doubles as layout
+        normalization, but an IN-MEMORY prior (the continuous-rebuild
+        daemon chains each generation's PartitionResult straight into
+        the next ``warm_rebuild``) is already columnar, and serializing
+        O(tree) bytes per revision just to copy arrays was the
+        daemon hot loop's dominant fixed cost.  Columns are copied
+        directly -- including the vertex matrices, which a pickle
+        round-trip would re-DERIVE from the roots to the same bits."""
+        t = Tree.__new__(Tree)
+        t.p, t.n_u = self.p, self.n_u
+        t.provenance = (None if self.provenance is None
+                        else dict(self.provenance))
+        t.excl_events = list(self.excl_events)
+        n, ns = self._n, self._n_slots
+        t._n = n
+        t._split_normals_live = self._split_normals_live
+        t._alloc(max(self._INIT_CAP, n))
+        for name in ("_vertices", "_parent", "_children", "_depth",
+                     "_split_edge", "_leaf_flags", "_leaf_slot",
+                     "_normal", "_offset"):
+            getattr(t, name)[:n] = getattr(self, name)[:n]
+        t._n_slots = ns
+        t._alloc_payload(max(self._INIT_CAP, ns))
+        t._pl_delta[:ns] = self._pl_delta[:ns]
+        t._pl_inputs[:ns] = self._pl_inputs[:ns]
+        t._pl_costs[:ns] = self._pl_costs[:ns]
+        t._pl_zidx[:ns] = self._pl_zidx[:ns]
+        t._z_store = (None if self._z_store is None
+                      else np.array(self._z_store[:self._z_n]))
+        t._z_n = self._z_n
+        t._n_regions = self._n_regions
+        t._max_depth = self._max_depth
+        return t
+
     # -- serialization -----------------------------------------------------
 
     def __getstate__(self) -> dict:

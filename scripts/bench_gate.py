@@ -119,6 +119,16 @@ GATED_METRICS: dict[str, tuple] = {
     # windows never mix metric families.
     "rebuild_reuse_frac": ("higher", 0.15, 0.05),
     "rebuild_speedup": ("higher", 0.30, 0.25),
+    # Continuous-rebuild lifecycle (bench.py --drift-walk;
+    # lifecycle/service.py): end-to-end staleness p99 (revision
+    # observed -> rebuilt controller live) and the delta-vs-full
+    # artifact byte ratio.  Both lower-is-better; staleness divides
+    # noisy 2-core build walls so it gets a wide band + absolute
+    # slack, the byte ratio is a deterministic structural figure and
+    # gates tight with a small absolute slack.  Drift rows carry no
+    # "value", so the trailing windows never mix metric families.
+    "staleness_p99_s": ("lower", 0.30, 2.0),
+    "delta_bytes_frac": ("lower", 0.15, 0.02),
     # Sharded-frontier multichip scaling (bench.py --multichip;
     # partition/shard.py): single-process build wall / sharded build
     # wall.  Higher is better; on the CPU virtual-device harness the
@@ -155,7 +165,15 @@ _ROW_EXTRAS = ("regions", "unit", "precision", "truncated",
                "singleproc_wall_s", "multichip_wall_s",
                "multichip_wall_sync_s", "multichip_overhead_ok",
                "cp_wait_frac_sync", "cp_wait_frac_async",
-               "cp_overlap_s", "async_certify")
+               "cp_overlap_s", "async_certify",
+               # Drift-walk rows (bench.py --drift-walk; lifecycle/):
+               # the per-generation reuse trajectory + ledger sizes
+               # are the PR-10 bounded-chain evidence (informational,
+               # not gated -- their healthy values are walk-shaped);
+               # staleness_p50_s rides next to the gated p99.
+               "drift_generations", "reuse_fracs", "reuse_decay",
+               "excl_events_trajectory", "staleness_p50_s",
+               "sla_misses", "revisions_superseded")
 
 
 def summarize(bench: dict, source: str, mtime: float | None = None) -> dict:

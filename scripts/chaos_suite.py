@@ -23,6 +23,13 @@ zero hangs:
    (the worst-ordered torn checkpoint; only ``.prev`` survives);
    supervise_build.py restarts it with ``--resume`` and the loader's
    generation fallback carries it home.
+4. **sharded_device_failure**: a 2-process sharded build with the
+   fault plan in one shard's env only (shard-local isolation).
+5. **lifecycle_publish_crash**: the continuous-rebuild daemon
+   (lifecycle/) dies between a delta artifact landing on disk and
+   the registry swap -- the prior generation must stay the last
+   committed artifact, node-for-node identical to a fault-free run's
+   (disk-state verdicts, not tree comparison).
 
 Each schedule runs under a hard subprocess timeout -- a hung child is
 itself a FAILURE (the no-hang half of the acceptance criterion).
@@ -82,6 +89,21 @@ SCHEDULES: dict[str, dict] = {
         "process_exit": True,
         "faults": [
             {"site": "checkpoint.write", "kind": "crash", "at": 2},
+        ]},
+    # Crash-mid-publish (PR 15, lifecycle/): the rebuild daemon dies
+    # (os._exit) between generation 1's DELTA artifact landing on
+    # disk and the registry swap.  The disk contract under test: the
+    # generation-0 full artifact stays the last COMMITTED artifact
+    # (meta.json marker) and still loads node-for-node identical to a
+    # fault-free daemon's generation 0 -- a restarted replica serves
+    # the OLD version, never a torn half-generation; the crashed
+    # generation's full dir must NOT carry a commit marker.
+    "lifecycle_publish_crash": {
+        "lifecycle": True,
+        "process_exit": True,
+        "faults": [
+            {"site": "lifecycle.publish_delta", "kind": "crash",
+             "at": 1},
         ]},
     # Shard-local failure isolation (PR 14): a 2-process SHARDED build
     # (scripts/shard_launch.py) with a dead device scripted on SHARD 1
@@ -199,6 +221,103 @@ def compare_trees_canonical_paths(ref_path: str, cand_path: str,
                                    payloads=payloads)
 
 
+def _serve_rebuild_argv(artifacts_root: str, eps: float,
+                        batch: int) -> list[str]:
+    return ["serve-rebuild", "-e", "double_integrator", *PROBLEM_ARGS,
+            "-a", str(eps), "--backend", "cpu", "--batch", str(batch),
+            "--controller", "di", "--revisions", "2",
+            "--drift-frac", "0.05", "--artifacts-root", artifacts_root]
+
+
+def compare_artifact_dirs(a: str, b: str) -> list[str]:
+    """Bitwise divergence list between two published serving artifact
+    directories (leaf-table fields + descent arrays)."""
+    import numpy as np
+
+    diffs: list[str] = []
+    for k in ("bary_M", "U", "V", "delta", "node_id"):
+        xa = np.load(os.path.join(a, f"{k}.npy"))
+        xb = np.load(os.path.join(b, f"{k}.npy"))
+        if not np.array_equal(xa, xb):
+            diffs.append(f"leaf field {k} differs")
+    with np.load(os.path.join(a, "descent.npz")) as za, \
+            np.load(os.path.join(b, "descent.npz")) as zb:
+        for k in za.files:
+            if not np.array_equal(za[k], zb[k]):
+                diffs.append(f"descent {k} differs")
+    return diffs
+
+
+def run_lifecycle_schedule(wd: str, plan_path: str, eps: float,
+                           batch: int, timeout_s: float) -> dict:
+    """Crash-mid-publish drill: a fault-free 2-revision daemon run
+    (the node-for-node reference) vs one crashed by the plan between
+    delta write and swap; verdicts on the surviving DISK state."""
+    art_ref = os.path.join(wd, "lc_ref")
+    art_crash = os.path.join(wd, "lc_crash")
+    env = _env()
+    t0 = time.time()
+    rc_ref = subprocess.call(
+        [sys.executable, "-m", "explicit_hybrid_mpc_tpu.main"]
+        + _serve_rebuild_argv(art_ref, eps, batch),
+        env=env, cwd=REPO, timeout=timeout_s)
+    env_crash = dict(env)
+    env_crash["EHM_FAULT_PLAN"] = plan_path
+    try:
+        rc = subprocess.call(
+            [sys.executable, "-m", "explicit_hybrid_mpc_tpu.main"]
+            + _serve_rebuild_argv(art_crash, eps, batch),
+            env=env_crash, cwd=REPO, timeout=timeout_s)
+        hung = False
+    except subprocess.TimeoutExpired:
+        rc, hung = -9, True
+    row = {"rc": rc, "rc_ref": rc_ref, "hung": hung,
+           "wall_s": round(time.time() - t0, 1), "failures": []}
+    if hung or rc_ref != 0:
+        row["failures"].append(
+            f"reference rc={rc_ref}, crashed-run hung={hung}")
+        return row
+    if rc == 0:
+        row["failures"].append(
+            "crashed run exited 0: the scripted publish crash never "
+            "fired (vacuous drill)")
+        return row
+
+    def _gens(root: str) -> dict[int, str]:
+        d = os.path.join(root, "di")
+        out = {}
+        for name in (os.listdir(d) if os.path.isdir(d) else []):
+            if name.startswith("g") and not name.endswith(".delta"):
+                out[int(name[1:5])] = os.path.join(d, name)
+        return out
+
+    ref, crash = _gens(art_ref), _gens(art_crash)
+    if 0 not in ref or 1 not in ref:
+        row["failures"].append(f"reference run published {sorted(ref)}"
+                               ", expected generations 0 and 1")
+        return row
+    if 0 not in crash:
+        row["failures"].append("crashed run lost generation 0")
+        return row
+    # The crash window: delta on disk, swap (and the applied full
+    # dir's commit marker) never ran.
+    if 1 in crash and os.path.exists(
+            os.path.join(crash[1], "meta.json")):
+        row["failures"].append(
+            "crashed generation 1 carries a COMMIT MARKER: the crash "
+            "site fired after the swap (window broken)")
+    if not os.path.exists(os.path.join(crash[0], "meta.json")):
+        row["failures"].append(
+            "surviving generation 0 lost its commit marker")
+    diffs = compare_artifact_dirs(ref[0], crash[0])
+    row["tree_diffs"] = diffs
+    if diffs:
+        row["failures"].append(
+            "surviving generation 0 diverged from the fault-free "
+            "reference: " + "; ".join(diffs))
+    return row
+
+
 def run_sharded_schedule(prefix: str, plan_path: str, fault_shard: int,
                          eps: float, batch: int,
                          timeout_s: float) -> dict:
@@ -267,6 +386,23 @@ def main(argv: list[str] | None = None) -> int:
                        "process_exit": spec.get("process_exit", False),
                        "faults": spec["faults"]}, f, indent=2)
         print(f"chaos: schedule {name} ...", file=sys.stderr)
+        if spec.get("lifecycle"):
+            # Daemon crash drill: its verdicts are disk-state checks
+            # (commit markers + node-for-node artifact parity), not
+            # the build-tree comparison below.
+            r = run_lifecycle_schedule(wd, plan_path, args.eps,
+                                       args.batch, args.timeout)
+            verdict["schedules"][name] = {
+                k: v for k, v in r.items() if k != "failures"}
+            failures.extend(f"{name}: {m}" for m in r["failures"])
+            if r["hung"]:
+                failures.append(f"{name}: daemon HUNG "
+                                f"(> {args.timeout}s)")
+            elif not r["failures"]:
+                print(f"chaos: {name}: crash-mid-publish left "
+                      "generation 0 serving, node-for-node identical",
+                      file=sys.stderr)
+            continue
         sharded = spec.get("sharded", False)
         if sharded:
             r = run_sharded_schedule(prefix, plan_path,

@@ -1,0 +1,224 @@
+"""Pre-merge check #6: the continuous-rebuild daemon under live load.
+
+Drives the tier-1 double_integrator flagship config through the
+lifecycle loop (explicit_hybrid_mpc_tpu/lifecycle/; docs/lifecycle.md)
+END TO END, the way production would run it: a 3-revision simulated
+plant-drift walk feeds a live ``RebuildService`` -- cold generation 0,
+then delta-compressed warm generations -- while a ``RequestScheduler``
+serves a CONCURRENT query load across every hot swap.  Exits nonzero
+unless:
+
+- every revision produced a live generation (0 rebuild failures, 0
+  delta fallbacks, at least one DELTA publish);
+- the serve load saw ZERO dropped requests (every ticket resolves)
+  and ZERO torn swaps -- every served result is BITWISE equal to
+  re-evaluating its theta against a fresh load of the artifact
+  directory its result-version names (the registry's two-epoch lease
+  means a batch can never mix trees; a torn read would show up as a
+  value from one generation attributed to another);
+- end-to-end staleness p99 (revision observed -> new controller
+  live) stays under the budget;
+- the daemon's own obs stream carries the lifecycle.* counters.
+
+Usage (docs/perf.md pre-merge checklist, ~1-2 min CPU)::
+
+    python scripts/drift_smoke.py
+    python scripts/drift_smoke.py --eps 0.5        # quicker smoke
+    python scripts/drift_smoke.py --staleness-budget 60
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+PROBLEM_ARGS = (("N", 3), ("theta_box", 1.5))
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--eps", type=float, default=0.2,
+                    help="eps_a (default 0.2 = the 392-region tier-1 "
+                         "flagship; raise for a quicker smoke)")
+    ap.add_argument("--batch", type=int, default=64)
+    ap.add_argument("--revisions", type=int, default=3)
+    ap.add_argument("--staleness-budget", type=float, default=120.0,
+                    metavar="S", help="staleness p99 budget "
+                    "(revision observed -> live; default 120 s -- "
+                    "generous for the 2-core CPU harness)")
+    ap.add_argument("--timeout", type=float, default=900.0,
+                    help="whole-run hang budget")
+    ap.add_argument("--workdir", default=None,
+                    help="keep artifacts here instead of a temp dir")
+    ap.add_argument("--json", dest="json_out", default=None)
+    args = ap.parse_args(argv)
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from explicit_hybrid_mpc_tpu.config import PartitionConfig
+    from explicit_hybrid_mpc_tpu.lifecycle import (DriftSource,
+                                                   LifecycleConfig,
+                                                   RebuildService)
+    from explicit_hybrid_mpc_tpu.obs import Obs
+    from explicit_hybrid_mpc_tpu.serve.registry import ControllerRegistry
+    from explicit_hybrid_mpc_tpu.serve.scheduler import RequestScheduler
+
+    wd = args.workdir or tempfile.mkdtemp(prefix="drift_smoke.")
+    os.makedirs(wd, exist_ok=True)
+    failures: list[str] = []
+    obs_path = os.path.join(wd, "lifecycle.obs.jsonl")
+    obs = Obs("jsonl", path=obs_path)
+    registry = ControllerRegistry(obs=obs)
+    build_cfg = PartitionConfig(
+        problem="double_integrator", problem_args=PROBLEM_ARGS,
+        eps_a=args.eps, backend="cpu", batch_simplices=args.batch)
+    source = DriftSource(
+        "double_integrator", problem_args=PROBLEM_ARGS,
+        controller="di", eps_a=args.eps, drift_arg="u_max",
+        drift_frac=0.05, n_revisions=args.revisions, probe_T=10,
+        seed=7)
+    svc = RebuildService(
+        source, build_cfg,
+        cfg=LifecycleConfig(artifacts_root=os.path.join(wd, "art"),
+                            sla_s=args.staleness_budget),
+        registry=registry, obs=obs)
+    source.gate = (lambda: len(svc.generations) + svc.n_failures
+                   >= source.n_emitted)
+
+    print(f"drift_smoke: {args.revisions}-revision walk, eps "
+          f"{args.eps} ...", file=sys.stderr)
+    t0 = time.time()
+    svc.start()
+    # Generation 0 must be live before traffic can flow.
+    if not svc.wait_idle(timeout=args.timeout, target_generations=1):
+        print("drift_smoke: generation 0 never went live "
+              f"({svc.worker_error or 'timeout'})", file=sys.stderr)
+        svc.close()
+        return 2
+
+    # -- concurrent serve load across the remaining swaps ------------------
+    sched = RequestScheduler(registry, "di", max_batch=32,
+                             max_wait_us=2000.0, obs=obs)
+    served: list[tuple[np.ndarray, object]] = []
+    dropped: list[str] = []
+    stop = threading.Event()
+    rng = np.random.default_rng(3)
+
+    def load_loop() -> None:
+        lb = -1.5 * 0.95 * np.ones(2)
+        ub = 1.5 * 0.95 * np.ones(2)
+        while not stop.is_set():
+            thetas = rng.uniform(lb, ub, size=(8, 2))
+            try:
+                results = sched.submit_batch(thetas).result(timeout=30)
+            except Exception as e:  # noqa: BLE001 -- a drop IS the verdict
+                dropped.append(repr(e))
+                continue
+            served.extend(zip(thetas, results))
+            time.sleep(0.002)
+
+    loader = threading.Thread(target=load_loop, daemon=True)
+    loader.start()
+    ok = svc.wait_idle(timeout=args.timeout,
+                       target_generations=args.revisions)
+    time.sleep(0.3)  # a few more batches against the final version
+    stop.set()
+    loader.join(30)
+    sched.close()
+    svc.close()
+    obs.close()
+    summary = svc.summary()
+    wall = time.time() - t0
+
+    if not ok:
+        failures.append(
+            f"daemon did not complete {args.revisions} generations "
+            f"({svc.worker_error or 'timeout'}; "
+            f"{len(svc.generations)} done, {svc.n_failures} failed)")
+    if summary["failures"]:
+        failures.append(f"{summary['failures']} rebuild failure(s)")
+    if summary["delta_publishes"] < 1:
+        failures.append("no delta publish happened (every generation "
+                        "fell back to full artifacts)")
+    counters = obs.metrics.snapshot()["counters"]
+    if counters.get("lifecycle.delta_fallbacks", 0):
+        failures.append(f"{counters['lifecycle.delta_fallbacks']} "
+                        "delta fallback(s) on a healthy walk")
+    if dropped:
+        failures.append(f"{len(dropped)} DROPPED request(s): "
+                        f"{dropped[:3]}")
+    if not served:
+        failures.append("serve load produced no results (scheduler "
+                        "never ran against the daemon)")
+    p99 = summary.get("staleness_p99_s")
+    if p99 is None or p99 > args.staleness_budget:
+        failures.append(f"staleness p99 {p99}s over the "
+                        f"{args.staleness_budget}s budget")
+
+    # -- torn-swap audit: every result bitwise vs its version's table ------
+    by_version: dict[str, list[int]] = {}
+    for i, (_th, r) in enumerate(served):
+        by_version.setdefault(r.version, []).append(i)
+    dirs = {g["version"]: g["artifact_dir"] for g in svc.generations}
+    torn = 0
+    for version, idxs in sorted(by_version.items()):
+        d = dirs.get(version)
+        if d is None:
+            failures.append(f"served version {version!r} matches no "
+                            "published generation")
+            continue
+        ref_reg = ControllerRegistry()
+        ref_reg.load_artifacts("ref", version, d)
+        with ref_reg.lease("ref") as ver:
+            thetas = np.stack([served[i][0] for i in idxs])
+            ref = ver.server.evaluate(thetas)
+        for j, i in enumerate(idxs):
+            r = served[i][1]
+            if r.fallback is not None:
+                continue  # degraded-mode rows re-evaluate differently
+            if not (np.array_equal(r.u, np.asarray(ref.u[j]))
+                    and r.leaf == int(ref.leaf[j])):
+                torn += 1
+    if torn:
+        failures.append(f"{torn} TORN result(s): served values do "
+                        "not match their claimed version's artifact")
+
+    verdict = {
+        "wall_s": round(wall, 1), "summary": summary,
+        "served": len(served), "dropped": len(dropped), "torn": torn,
+        "versions_served": sorted(by_version),
+        "failures": failures,
+    }
+    if args.json_out:
+        with open(args.json_out, "w") as f:
+            json.dump(verdict, f, indent=2)
+    if not args.workdir:
+        shutil.rmtree(wd, ignore_errors=True)
+    if failures:
+        print("DRIFT SMOKE FAILED:", file=sys.stderr)
+        for msg in failures:
+            print("  " + msg, file=sys.stderr)
+        return 1
+    print(f"DRIFT SMOKE OK: {summary['generations']} generations "
+          f"({summary['delta_publishes']} delta), {len(served)} "
+          f"requests served across swaps, 0 dropped / 0 torn, "
+          f"staleness p99 {p99}s (budget {args.staleness_budget}s), "
+          f"{wall:.0f}s wall", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

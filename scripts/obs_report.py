@@ -179,6 +179,32 @@ def report(records: list[dict]) -> dict:
             reb["reuse_frac"] = out["gauges"]["rebuild.reuse_frac"]
         if reb:
             out["rebuild"] = reb
+        # Continuous-rebuild lifecycle (lifecycle/service.py): revision
+        # flow counters, rolling staleness gauges, and the reuse-decay
+        # trajectory off the per-generation lifecycle.rebuilt events.
+        lc = {c: out["counters"][f"lifecycle.{c}"]
+              for c in ("revisions_seen", "rebuilds",
+                        "revisions_superseded", "rebuild_failures",
+                        "sla_misses", "publishes_delta",
+                        "publishes_full", "delta_fallbacks")
+              if f"lifecycle.{c}" in out["counters"]}
+        for g in ("staleness_p50_s", "staleness_p99_s",
+                  "last_reuse_frac", "delta_bytes_frac", "generation",
+                  "excl_events"):
+            if f"lifecycle.{g}" in out["gauges"]:
+                lc[g] = out["gauges"][f"lifecycle.{g}"]
+        if lc:
+            reuse = [r.get("reuse_frac") for r in records
+                     if r.get("kind") == "event"
+                     and r.get("name") == "lifecycle.rebuilt"
+                     and r.get("reuse_frac") is not None]
+            if reuse:
+                decay, cur = [], 1.0
+                for v in reuse:
+                    cur = min(cur, float(v))
+                    decay.append(round(cur, 4))
+                lc["reuse_decay"] = decay
+            out["lifecycle"] = lc
         # Robustness ledger (faults/; docs/robustness.md): injected
         # faults that fired, poison cells quarantined, and the
         # degraded/lease-leak/quarantine health events -- zero on any
@@ -340,6 +366,27 @@ def diff_bench(rep: dict, bench: dict, tol: float = 0.10) -> list[str]:
         flags.append(
             f"rebuild reuse regression: {r_reuse:.3f} vs bench "
             f"{b_reuse:.3f} ({100 * (1 - r_reuse / b_reuse):.0f}% lower)")
+    # Lifecycle staleness regression (ISSUE 15): a daemon whose
+    # end-to-end staleness p99 grew past the last BENCH_drift row is
+    # going live slower per revision -- flagged like a latency
+    # regression (directional; faster is not a flag).  The delta byte
+    # ratio gates the same way: a fatter delta re-ships tree bytes the
+    # rebuild did not actually invalidate.
+    lc = rep.get("lifecycle", {})
+    b_stale = bench.get("staleness_p99_s")
+    r_stale = lc.get("staleness_p99_s")
+    if b_stale and r_stale is not None \
+            and r_stale > (1 + tol) * b_stale:
+        flags.append(
+            f"lifecycle staleness regression: p99 {r_stale:.2f}s vs "
+            f"bench {b_stale:.2f}s "
+            f"({100 * (r_stale / b_stale - 1):.0f}% slower)")
+    b_df = bench.get("delta_bytes_frac")
+    r_df = lc.get("delta_bytes_frac")
+    if b_df and r_df is not None and r_df > (1 + tol) * b_df:
+        flags.append(
+            f"delta-artifact size regression: {r_df:.3f} of full vs "
+            f"bench {b_df:.3f}")
     b_waste = bench.get("spec_waste_frac")
     r_waste = pipe.get("spec_waste_frac")
     if r_waste is not None and b_waste is not None \
@@ -449,6 +496,20 @@ def render_text(rep: dict, flags: list[str], bench_path: str | None) -> str:
             f"{int(reb.get('leaves_reused', 0)) + int(reb.get('leaves_invalidated', 0))}"
             f" prior leaves (reuse_frac {reb.get('reuse_frac', 0.0):.3f}"
             f", {int(reb.get('recert_solves', 0))} recert solves)")
+    lc = rep.get("lifecycle")
+    if lc:
+        decay = lc.get("reuse_decay")
+        ln.append(
+            f"lifecycle: {int(lc.get('revisions_seen', 0))} revisions "
+            f"seen, {int(lc.get('rebuilds', 0))} rebuilt, "
+            f"{int(lc.get('revisions_superseded', 0))} superseded, "
+            f"staleness p50 {lc.get('staleness_p50_s', 0.0):.2f}s / "
+            f"p99 {lc.get('staleness_p99_s', 0.0):.2f}s, "
+            f"delta bytes frac {lc.get('delta_bytes_frac', 0.0):.3f}"
+            + (f", reuse decay {' -> '.join(f'{v:.3f}' for v in decay)}"
+               if decay else "")
+            + (f", {int(lc['sla_misses'])} SLA MISS(ES)"
+               if lc.get("sla_misses") else ""))
     flt = rep.get("faults")
     if flt:
         ln.append(

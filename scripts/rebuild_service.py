@@ -1,0 +1,29 @@
+#!/usr/bin/env python
+"""Standalone wrapper for the continuous rebuild daemon.
+
+Equivalent to ``python -m explicit_hybrid_mpc_tpu.main serve-rebuild``
+(explicit_hybrid_mpc_tpu/lifecycle/cli.py; docs/lifecycle.md):
+watches a revision stream (simulated plant drift or a JSONL file),
+warm-rebuilds each revision under the staleness SLA, publishes
+delta-compressed artifacts, and hot-swaps them into the serving
+registry.
+
+    python scripts/rebuild_service.py -e double_integrator \\
+        --problem-arg N=3 --problem-arg theta_box=1.5 -a 0.2 \\
+        --backend cpu --revisions 3 --artifacts-root /tmp/lc
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from explicit_hybrid_mpc_tpu.lifecycle.cli import (  # noqa: E402
+    serve_rebuild_main)
+
+if __name__ == "__main__":
+    raise SystemExit(serve_rebuild_main())

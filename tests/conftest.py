@@ -95,6 +95,7 @@ _SLOW = (
     "test_partition.py::test_serial_vs_batched_region_parity",
     "test_partition.py::test_vertex_cache_shares_work_and_bounds_memory",
     "test_partition.py::test_checkpoint_resume",
+    "test_lifecycle.py::test_k20_drift_walk_ledger_bounded_and_decay_monotone",
     "test_problems.py::test_prestab_condense_is_exact_substitution",
     "test_quadrotor.py::test_partition_build_coarse",
     "test_quadrotor.py::test_enumeration_matches_admm_reference",

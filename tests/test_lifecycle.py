@@ -1,0 +1,680 @@
+"""Continuous rebuild lifecycle (explicit_hybrid_mpc_tpu/lifecycle/).
+
+Contract tests for ISSUE 15: revision sources (drift walk + JSONL
+tail), delta-compressed artifacts (bitwise-identical apply, loud
+rejection of wrong bases / corruption), the live daemon (end-to-end
+revision -> warm rebuild -> delta publish -> hot swap under traffic,
+coalescing, failure containment, crash-mid-publish), the K-generation
+ledger-pruning walk (the PR-10 bounded-chain claim), and the obs /
+health / gate wiring.
+"""
+
+import dataclasses
+import importlib.util
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from explicit_hybrid_mpc_tpu.config import PartitionConfig
+from explicit_hybrid_mpc_tpu.faults import injector as faults_inj
+from explicit_hybrid_mpc_tpu.faults.plan import FaultPlan, FaultSpec
+from explicit_hybrid_mpc_tpu.lifecycle import (DeltaMismatch, DriftSource,
+                                               FileRevisionSource,
+                                               LifecycleConfig,
+                                               RebuildService, Revision,
+                                               RevisionSource, apply_delta,
+                                               delta_size_bytes,
+                                               plant_divergence,
+                                               write_delta_artifact)
+from explicit_hybrid_mpc_tpu.obs import Obs
+from explicit_hybrid_mpc_tpu.obs.health import HealthMonitor
+from explicit_hybrid_mpc_tpu.partition.frontier import build_partition
+from explicit_hybrid_mpc_tpu.partition.rebuild import warm_rebuild
+from explicit_hybrid_mpc_tpu.problems.registry import make
+from explicit_hybrid_mpc_tpu.serve.registry import ControllerRegistry
+from explicit_hybrid_mpc_tpu.utils.atomic import CorruptArtifact
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+DI_ARGS = (("N", 3), ("theta_box", 1.5))
+
+
+@pytest.fixture(scope="module")
+def di_problem():
+    return make("double_integrator", **dict(DI_ARGS))
+
+
+@pytest.fixture(scope="module")
+def di_cfg():
+    return PartitionConfig(problem="double_integrator",
+                           problem_args=DI_ARGS, eps_a=0.3,
+                           backend="cpu", batch_simplices=128)
+
+
+@pytest.fixture(scope="module")
+def prior(di_problem, di_cfg):
+    return build_partition(di_problem, di_cfg)
+
+
+@pytest.fixture(scope="module")
+def base_dir(prior, tmp_path_factory):
+    """The prior generation's FULL serving artifact (delta base)."""
+    from explicit_hybrid_mpc_tpu.serve.registry import save_artifacts
+
+    d = str(tmp_path_factory.mktemp("lc") / "base")
+    save_artifacts(prior.tree, prior.roots, d)
+    return d
+
+
+@pytest.fixture(scope="module")
+def revised(di_cfg, prior):
+    """A plant-drifted warm rebuild chained on `prior` IN MEMORY (the
+    daemon's hot-loop shape: PartitionResult prior, no pickle)."""
+    prob2 = make("double_integrator", **dict(DI_ARGS), u_max=0.95)
+    cfg2 = dataclasses.replace(
+        di_cfg, problem_args=DI_ARGS + (("u_max", 0.95),))
+    return warm_rebuild(prob2, cfg2, prior)
+
+
+class ListSource(RevisionSource):
+    """Test source: hands out a scripted revision list, then dries up."""
+
+    def __init__(self, revisions):
+        self._revs = list(revisions)
+
+    def poll(self):
+        out, self._revs = self._revs, []
+        return out
+
+
+class StagedSource(RevisionSource):
+    """Test source releasing revision batches behind ready-gates, so
+    enqueue-vs-claim interleavings are deterministic."""
+
+    def __init__(self, stages):
+        self._stages = list(stages)  # [(ready_fn, [revisions])]
+
+    def poll(self):
+        if self._stages and self._stages[0][0]():
+            return self._stages.pop(0)[1]
+        return []
+
+
+def _rev(seq, controller="di", eps=0.3, extra=(), problem_args=DI_ARGS):
+    return Revision(controller=controller, problem="double_integrator",
+                    problem_args=tuple(sorted(problem_args + extra)),
+                    eps_a=eps, seq=seq, t_observed=time.perf_counter())
+
+
+# -- chained-prior ergonomics (satellite 1) --------------------------------
+
+
+def test_warm_rebuild_accepts_partition_result(di_problem, di_cfg,
+                                               prior):
+    res = warm_rebuild(di_problem, di_cfg, prior)
+    assert res.stats["rebuild_prior_source"] == "result"
+    assert res.stats["rebuild_reuse_frac"] == 1.0
+    assert res.stats["subdivision_solves"] == 0
+
+
+def test_tree_clone_matches_pickle_roundtrip(prior):
+    import pickle
+
+    a = prior.tree.clone()
+    b = pickle.loads(pickle.dumps(prior.tree))
+    sa, sb = a.__getstate__(), b.__getstate__()
+    assert set(sa) == set(sb)
+    for k in sa:
+        va, vb = sa[k], sb[k]
+        if isinstance(va, np.ndarray) or isinstance(vb, np.ndarray):
+            assert np.array_equal(va, vb), k
+        else:
+            assert va == vb, k
+    # Deep copy: mutating the clone leaves the original untouched.
+    a.excl_events.append((0, 0, np.inf))
+    assert len(prior.tree.excl_events) == len(b.excl_events)
+
+
+# -- revision sources ------------------------------------------------------
+
+
+def test_file_revision_source_tails_and_tolerates_torn_tail(tmp_path):
+    p = str(tmp_path / "revs.jsonl")
+    full = json.dumps({"problem": "double_integrator",
+                       "problem_args": {"N": 3}, "eps_a": 0.25})
+    with open(p, "w") as f:
+        f.write(full + "\n")
+        f.write(full + "\n")
+        f.write('{"problem": "double_int')  # torn: writer mid-append
+    src = FileRevisionSource(p, controller="di")
+    revs = src.poll()
+    assert [r.seq for r in revs] == [1, 2]
+    assert revs[0].controller == "di"
+    assert revs[0].problem_args == (("N", 3),)
+    assert revs[0].eps_a == 0.25
+    assert src.poll() == []  # torn tail not consumed
+    with open(p, "a") as f:
+        f.write('egrator"}\n')
+    revs = src.poll()
+    assert len(revs) == 1 and revs[0].seq == 3
+    assert revs[0].problem == "double_integrator"
+
+
+def test_drift_source_deterministic_bounded_and_exhausts():
+    kw = dict(problem_args=DI_ARGS, eps_a=0.3, drift_arg="u_max",
+              drift_frac=0.05, max_drift_frac=0.2, n_revisions=4,
+              seed=3)
+    a = DriftSource("double_integrator", **kw)
+    b = DriftSource("double_integrator", **kw)
+    ra = [r for _ in range(10) for r in a.poll()]
+    rb = [r for _ in range(10) for r in b.poll()]
+    assert len(ra) == 4 and a.exhausted()
+    assert [r.problem_args for r in ra] == [r.problem_args for r in rb]
+    for r in ra:
+        u = dict(r.problem_args)["u_max"]
+        assert abs(u - 1.0) <= 0.2 + 1e-12  # bounded walk
+    assert len({r.problem_args for r in ra}) > 1  # it does drift
+
+
+def test_drift_source_refuses_box_drift_and_unknown_arg():
+    with pytest.raises(ValueError, match="root geometry"):
+        DriftSource("double_integrator", drift_arg="theta_box")
+    with pytest.raises(ValueError, match="no numeric"):
+        DriftSource("double_integrator", drift_arg="nonsense")
+
+
+def test_plant_divergence_observable(di_problem):
+    same = make("double_integrator", **dict(DI_ARGS))
+    assert plant_divergence(di_problem, same, T=10) == 0.0
+    drifted = make("double_integrator", **dict(DI_ARGS), dt=0.3)
+    assert plant_divergence(di_problem, drifted, T=10) > 0.0
+
+
+def test_drift_source_gates_on_divergence():
+    # u_max is a CONSTRAINT parameter: the open-loop probe sees zero
+    # divergence, so a min_divergence gate must suppress emission.
+    src = DriftSource("double_integrator", problem_args=DI_ARGS,
+                      drift_arg="u_max", drift_frac=0.05,
+                      n_revisions=3, probe_T=5, min_divergence=1e-9)
+    assert [r for _ in range(5) for r in src.poll()] == []
+    # dt drifts the dynamics: observable, so revisions flow.
+    src2 = DriftSource("double_integrator", problem_args=DI_ARGS,
+                       drift_arg="dt", drift_frac=0.05,
+                       n_revisions=2, probe_T=5, min_divergence=1e-9)
+    revs = [r for _ in range(5) for r in src2.poll()]
+    assert len(revs) == 2
+    assert all("divergence" in r.note for r in revs)
+
+
+# -- delta artifacts -------------------------------------------------------
+
+
+def test_delta_apply_bitwise_identical_to_full(revised, base_dir,
+                                               tmp_path):
+    from explicit_hybrid_mpc_tpu.online import descent as descent_mod
+    from explicit_hybrid_mpc_tpu.online import export as export_mod
+    from explicit_hybrid_mpc_tpu.serve.registry import save_artifacts
+
+    delta_dir = str(tmp_path / "v1.delta")
+    stats = write_delta_artifact(revised.tree, revised.roots, delta_dir,
+                                 base_dir, base_version="v0")
+    assert stats["n_kept"] > 0
+    out_dir = str(tmp_path / "v1")
+    meta = apply_delta(delta_dir, base_dir, out_dir)
+    assert meta["kind"] == "ehm-delta-v1"
+    full_dir = str(tmp_path / "v1full")
+    save_artifacts(revised.tree, revised.roots, full_dir)
+    ta = export_mod.load_leaf_table(out_dir)
+    tb = export_mod.load_leaf_table(full_dir)
+    for k in ("bary_M", "U", "V", "delta", "node_id"):
+        assert np.array_equal(np.asarray(getattr(ta, k)),
+                              np.asarray(getattr(tb, k))), k
+    da = descent_mod.load_descent(os.path.join(out_dir, "descent.npz"))
+    db = descent_mod.load_descent(os.path.join(full_dir, "descent.npz"))
+    for k in ("root_bary", "root_node", "children", "normal", "offset",
+              "leaf_row"):
+        assert np.array_equal(np.asarray(getattr(da, k)),
+                              np.asarray(getattr(db, k))), k
+    assert da.max_depth == db.max_depth
+    # The point of the format: the delta ships a fraction of the tree.
+    assert stats["delta_bytes"] < 0.5 * delta_size_bytes(full_dir)
+    # The applied dir is a first-class artifact: registry-loadable
+    # with provenance enforcement.
+    reg = ControllerRegistry()
+    reg.load_artifacts("di", "v1", out_dir,
+                       expect_provenance=revised.tree.provenance,
+                       strict=True)
+
+
+def test_delta_rejects_wrong_base(revised, base_dir, tmp_path, prior):
+    from explicit_hybrid_mpc_tpu.serve.registry import save_artifacts
+
+    delta_dir = str(tmp_path / "d.delta")
+    write_delta_artifact(revised.tree, revised.roots, delta_dir,
+                         base_dir, base_version="v0")
+    # A DIFFERENT base generation (the revised tree's own full
+    # artifact): provenance stamp differs from the recorded base.
+    wrong = str(tmp_path / "wrong_base")
+    save_artifacts(revised.tree, revised.roots, wrong)
+    with pytest.raises(DeltaMismatch, match="provenance|generation"):
+        apply_delta(delta_dir, wrong, str(tmp_path / "out"))
+
+
+def test_delta_write_needs_committed_base(revised, tmp_path):
+    bare = str(tmp_path / "bare")
+    os.makedirs(bare)
+    with pytest.raises(DeltaMismatch, match="meta.json"):
+        write_delta_artifact(revised.tree, revised.roots,
+                             str(tmp_path / "d.delta"), bare)
+
+
+def test_delta_apply_detects_corruption(revised, base_dir, tmp_path):
+    delta_dir = str(tmp_path / "d.delta")
+    write_delta_artifact(revised.tree, revised.roots, delta_dir,
+                         base_dir, base_version="v0")
+    # Flip one byte of a fresh leaf row: the content-sha commitment
+    # must refuse to serve the franken-table.
+    p = os.path.join(delta_dir, "fresh_U.npy")
+    with open(p, "r+b") as f:
+        f.seek(-1, os.SEEK_END)
+        b = f.read(1)
+        f.seek(-1, os.SEEK_END)
+        f.write(bytes([b[0] ^ 0x40]))
+    with pytest.raises(CorruptArtifact, match="hash|corrupted"):
+        apply_delta(delta_dir, base_dir, str(tmp_path / "out"))
+    # A delta with no commit marker is torn, not legacy.
+    os.unlink(os.path.join(delta_dir, "delta_meta.json"))
+    with pytest.raises(CorruptArtifact, match="never .*committed"):
+        apply_delta(delta_dir, base_dir, str(tmp_path / "out2"))
+
+
+# -- the live daemon -------------------------------------------------------
+
+
+def test_service_e2e_swap_under_load(di_cfg, prior, tmp_path):
+    """THE acceptance demo: the daemon observes revisions, warm-
+    rebuilds, publishes deltas, and the registry hot-swaps while a
+    scheduler serves traffic -- 0 dropped, 0 torn (every result
+    bitwise equals a fresh load of its version's artifact)."""
+    from explicit_hybrid_mpc_tpu.serve.scheduler import RequestScheduler
+
+    obs = Obs("jsonl", path=str(tmp_path / "lc.obs.jsonl"))
+    reg = ControllerRegistry(obs=obs)
+    src = DriftSource("double_integrator", problem_args=DI_ARGS,
+                      controller="di", eps_a=0.3, drift_arg="u_max",
+                      drift_frac=0.05, n_revisions=2, seed=5)
+    svc = RebuildService(
+        src, di_cfg,
+        cfg=LifecycleConfig(artifacts_root=str(tmp_path / "art"),
+                            sla_s=300.0),
+        registry=reg, prior={"di": prior}, obs=obs)
+    src.gate = (lambda: len(svc.generations) + svc.n_failures
+                >= src.n_emitted)
+    svc.start()
+    assert svc.wait_idle(timeout=300, target_generations=1)
+
+    sched = RequestScheduler(reg, "di", max_batch=32, obs=obs)
+    served, dropped = [], []
+    stop = threading.Event()
+    rng = np.random.default_rng(0)
+
+    def load():
+        while not stop.is_set():
+            thetas = rng.uniform(-1.4, 1.4, size=(4, 2))
+            try:
+                served.extend(
+                    zip(thetas, sched.submit_batch(thetas).result(30)))
+            except Exception as e:  # noqa: BLE001 -- a drop IS the verdict
+                dropped.append(e)
+
+    t = threading.Thread(target=load, daemon=True)
+    t.start()
+    assert svc.wait_idle(timeout=300, target_generations=2)
+    time.sleep(0.1)
+    stop.set()
+    t.join(30)
+    sched.close()
+    svc.close()
+    obs.close()
+
+    assert not dropped
+    assert served
+    assert svc.n_failures == 0
+    assert len(svc.generations) == 2
+    # Generation 0 seeded from a prior result publishes FULL (no base
+    # on disk yet); generation 1 ships a delta.
+    assert svc.generations[0]["published"] == "full"
+    assert svc.generations[1]["published"] == "delta"
+    assert reg.active_version("di") == svc.generations[1]["version"]
+    for g in svc.generations:
+        assert g["staleness_s"] > 0
+        assert g["reuse_frac"] is not None  # every generation was warm
+    # Torn audit: every served value bitwise vs its version's artifact.
+    dirs = {g["version"]: g["artifact_dir"] for g in svc.generations}
+    by_ver = {}
+    for th, r in served:
+        by_ver.setdefault(r.version, []).append((th, r))
+    assert set(by_ver) <= set(dirs)
+    for ver, rows in by_ver.items():
+        ref_reg = ControllerRegistry()
+        ref_reg.load_artifacts("ref", ver, dirs[ver])
+        with ref_reg.lease("ref") as v:
+            ref = v.server.evaluate(np.stack([th for th, _ in rows]))
+        for j, (_th, r) in enumerate(rows):
+            if r.fallback is None:
+                assert np.array_equal(r.u, np.asarray(ref.u[j]))
+    # The stream carries the lifecycle block obs_report renders.
+    from explicit_hybrid_mpc_tpu.obs.sink import load_jsonl
+
+    recs = load_jsonl(str(tmp_path / "lc.obs.jsonl"))
+    snaps = [r for r in recs if r.get("kind") == "metrics"]
+    c = snaps[-1]["counters"]
+    assert c["lifecycle.rebuilds"] == 2
+    assert c["lifecycle.publishes_delta"] == 1
+    assert c["lifecycle.sla_misses"] == 0
+    assert snaps[-1]["gauges"]["lifecycle.staleness_p99_s"] > 0
+
+
+def test_service_coalesces_revision_storm(di_cfg, prior, tmp_path):
+    obs = Obs("jsonl")
+    revs = [_rev(1, extra=(("u_max", 0.99),)),
+            _rev(2, extra=(("u_max", 0.98),)),
+            _rev(3, extra=(("u_max", 0.97),))]
+    holder: list = []
+    src = StagedSource([
+        (lambda: True, [revs[0]]),
+        # The storm lands only once rev 1 is IN FLIGHT, so exactly
+        # rev 2 sits queued for rev 3 to supersede.
+        (lambda: holder[0]._ctl["di"].in_flight, [revs[1], revs[2]]),
+    ])
+    svc = RebuildService(
+        src, di_cfg,
+        cfg=LifecycleConfig(artifacts_root=str(tmp_path / "art")),
+        prior={"di": prior}, obs=obs)
+    holder.append(svc)
+    with svc:
+        assert svc.wait_idle(timeout=300, target_generations=2)
+        assert svc.wait_idle(timeout=60)
+    assert svc.n_failures == 0
+    # rev 1 claimed immediately; rev 3 superseded rev 2 in the queue.
+    assert len(svc.generations) == 2
+    assert [g["seq"] for g in svc.generations] == [1, 3]
+    snap = obs.metrics.snapshot()["counters"]
+    assert snap["lifecycle.revisions_seen"] == 3
+    assert snap["lifecycle.revisions_superseded"] == 1
+
+
+def _wait_for(cond, timeout: float = 300.0) -> bool:
+    deadline = time.perf_counter() + timeout
+    while time.perf_counter() < deadline:
+        if cond():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def test_service_contains_failures_and_keeps_serving(di_cfg, prior,
+                                                     tmp_path):
+    reg = ControllerRegistry()
+    # rev 1 is fine; rev 2's box change cannot warm-transfer
+    # (RebuildError) -- the daemon must count it, keep the old
+    # version serving, and still process rev 3.
+    ok1 = _rev(1, extra=(("u_max", 0.99),))
+    bad = _rev(2, problem_args=(("N", 3), ("theta_box", 2.0)))
+    good = _rev(3, extra=(("u_max", 0.98),))
+    svc_box: list = []
+    src = StagedSource([
+        (lambda: True, [ok1]),
+        (lambda: len(svc_box[0].generations) >= 1, [bad]),
+        (lambda: svc_box[0].n_failures >= 1, [good]),
+    ])
+    svc = RebuildService(
+        src, di_cfg,
+        cfg=LifecycleConfig(artifacts_root=str(tmp_path / "art")),
+        registry=reg, prior={"di": prior})
+    svc_box.append(svc)
+    with svc:
+        assert svc.wait_idle(timeout=300, target_generations=1)
+        v1 = reg.active_version("di")
+        assert _wait_for(lambda: svc.n_failures == 1)
+        assert reg.active_version("di") == v1  # old version serving
+        assert svc.wait_idle(timeout=300, target_generations=2)
+    assert svc.n_failures == 1
+    assert len(svc.generations) == 2
+    assert reg.active_version("di") == svc.generations[-1]["version"]
+
+
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+def test_service_publish_crash_leaves_old_version_serving(
+        di_cfg, prior, tmp_path):
+    """Satellite 2 (in-process half of the chaos drill): an injected
+    crash BETWEEN the delta write and the swap kills the worker, the
+    registry keeps serving the prior generation, and the crashed
+    generation's directory never gets a commit marker."""
+    reg = ControllerRegistry()
+    plan = FaultPlan(faults=(
+        FaultSpec(site="lifecycle.publish_delta", kind="crash"),))
+    revs = [_rev(1, extra=(("u_max", 0.99),)),
+            _rev(2, extra=(("u_max", 0.98),))]
+    svc_box: list = []
+    src = StagedSource([
+        (lambda: True, [revs[0]]),
+        (lambda: len(svc_box[0].generations) >= 1, [revs[1]]),
+    ])
+    with faults_inj.activate(plan) as inj:
+        svc = RebuildService(
+            src, di_cfg,
+            cfg=LifecycleConfig(artifacts_root=str(tmp_path / "art")),
+            registry=reg, prior={"di": prior})
+        svc_box.append(svc)
+        svc.start()
+        # gen 0 publishes FULL (site never fires); gen 1's delta
+        # publish crashes the worker.
+        assert not svc.wait_idle(timeout=300, target_generations=2)
+        assert svc.worker_error is not None
+        inj.assert_all_fired()
+        v0 = svc.generations[0]["version"]
+        assert reg.active_version("di") == v0
+        # The crashed generation's full dir is absent or uncommitted.
+        art = os.path.join(str(tmp_path / "art"), "di")
+        for name in os.listdir(art):
+            if name.startswith("g0001") and not name.endswith(".delta"):
+                assert not os.path.exists(
+                    os.path.join(art, name, "meta.json"))
+        svc.close(timeout=5)
+
+
+def test_lifecycle_fault_sites_registered():
+    # Plans may script the new sites (validated at spec construction).
+    FaultSpec(site="lifecycle.revision", kind="error")
+    FaultSpec(site="lifecycle.publish_delta", kind="crash")
+
+
+# -- K-generation ledger pruning (satellite 3) -----------------------------
+
+
+def test_k20_drift_walk_ledger_bounded_and_decay_monotone(tmp_path):
+    """The PR-10 claim at K=20, previously untested beyond K=1: a
+    20-step eps/plant drift walk of chained warm rebuilds keeps the
+    stage-2 fact ledger BOUNDED (dead events pruned, duplicates
+    collapsed -- no monotone growth), and the service reports the
+    reuse decay MONOTONE (running min) consistent with the per-
+    generation stats."""
+    args = (("N", 2), ("theta_box", (0.25, 0.6)))
+    cfg = PartitionConfig(problem="inverted_pendulum",
+                          problem_args=args, eps_a=1.0,
+                          backend="cpu", batch_simplices=64)
+    prior = build_partition(make("inverted_pendulum", **dict(args)), cfg)
+    assert len(prior.tree.excl_events) > 0  # hybrid: a real ledger
+    src = DriftSource("inverted_pendulum", problem_args=args,
+                      controller="pend", eps_a=1.0, drift_arg="a",
+                      drift_frac=0.01, eps_frac=0.02, n_revisions=20,
+                      seed=2)
+    svc = RebuildService(
+        src, cfg,
+        cfg=LifecycleConfig(artifacts_root=str(tmp_path / "art"),
+                            delta_publish=True),
+        prior={"pend": prior})
+    src.gate = (lambda: len(svc.generations) + svc.n_failures
+                >= src.n_emitted)
+    with svc:
+        assert svc.wait_idle(timeout=600, target_generations=20)
+    assert svc.n_failures == 0
+    summary = svc.summary()
+    assert summary["generations"] == 20
+    sizes = summary["excl_events"]
+    # Bounded chains: the chained ledger never grows past a small
+    # multiple of the nominal build's (pruning drops dead events and
+    # collapses duplicates per rebuild; without it the transferred
+    # ledger would accrete every generation's fresh facts forever).
+    bound = 2 * len(prior.tree.excl_events) + 64
+    assert max(sizes) <= bound, (sizes, bound)
+    # Monotone-reported decay: non-increasing, consistent with the
+    # per-generation reuse fracs, and ending at their running min.
+    reuse = summary["reuse_fracs"]
+    decay = summary["reuse_decay"]
+    assert len(reuse) == 20 and len(decay) == 20
+    assert all(d2 <= d1 + 1e-12 for d1, d2 in zip(decay, decay[1:]))
+    assert decay == [round(float(m), 4) for m in
+                     np.minimum.accumulate(reuse)]
+    # The walk did drift: not every generation is a full-reuse no-op.
+    assert min(reuse) < 1.0
+    # Delta publishing held up across the whole chain.
+    assert summary["delta_publishes"] >= 18
+    assert summary["delta_bytes_frac"] < 0.8
+
+
+def test_summary_reports_monotone_decay_without_builds(di_cfg):
+    """The decay REPORTING contract alone (no builds): summary's
+    reuse_decay is the running min of the per-generation fracs --
+    non-increasing by construction, so a lucky late generation can
+    never mask an earlier collapse."""
+    svc = RebuildService(ListSource([]), di_cfg,
+                         cfg=LifecycleConfig(artifacts_root="unused"))
+    reuse = [1.0, 0.97, 0.99, 0.91, 0.95, 0.91]
+    for i, r in enumerate(reuse):
+        svc.generations.append(
+            {"generation": i, "reuse_frac": r, "excl_events": 10 + i,
+             "published": "delta", "delta_bytes": 10, "full_bytes": 100})
+        svc._staleness.append(1.0 + i)
+    s = svc.summary()
+    assert s["reuse_fracs"] == [round(r, 4) for r in reuse]
+    assert s["reuse_decay"] == [1.0, 0.97, 0.97, 0.91, 0.91, 0.91]
+    assert all(b <= a for a, b in zip(s["reuse_decay"],
+                                      s["reuse_decay"][1:]))
+    assert s["staleness_p50_s"] == pytest.approx(3.5)
+    assert s["delta_bytes_frac"] == pytest.approx(0.1)
+
+
+# -- obs / health / report / gate wiring -----------------------------------
+
+
+def test_health_staleness_rule():
+    mon = HealthMonitor({"max_staleness_s": 10.0})
+    rec = {"kind": "metrics",
+           "counters": {"lifecycle.rebuilds": 3},
+           "gauges": {"lifecycle.staleness_p99_s": 45.0}}
+    evs = mon.feed(rec)
+    assert any(e["name"] == "health.staleness" for e in evs)
+    assert mon.worst == "warn"
+    # Volume gate: no completed rebuild -> no verdict.
+    mon2 = HealthMonitor({"max_staleness_s": 10.0})
+    assert mon2.feed({"kind": "metrics", "counters": {},
+                      "gauges": {"lifecycle.staleness_p99_s": 45.0}}) \
+        == []
+    # 0 disables (the default: budgets are deployment-specific).
+    mon3 = HealthMonitor()
+    assert mon3.feed(rec) == []
+
+
+def _load_script(name: str):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "scripts", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_obs_report_renders_lifecycle_block(di_cfg, prior, tmp_path):
+    obs_report = _load_script("obs_report")
+    path = str(tmp_path / "lc.obs.jsonl")
+    obs = Obs("jsonl", path=path)
+    src = DriftSource("double_integrator", problem_args=DI_ARGS,
+                      controller="di", eps_a=0.3, drift_arg="u_max",
+                      drift_frac=0.05, n_revisions=1, seed=9)
+    svc = RebuildService(
+        src, di_cfg,
+        cfg=LifecycleConfig(artifacts_root=str(tmp_path / "art"),
+                            sla_s=1e-4),  # everything misses: rendered
+        prior={"di": prior}, obs=obs)
+    with svc:
+        assert svc.wait_idle(timeout=300, target_generations=1)
+    obs.close()
+    from explicit_hybrid_mpc_tpu.obs.sink import load_jsonl
+
+    rep = obs_report.report(load_jsonl(path))
+    lc = rep["lifecycle"]
+    assert lc["rebuilds"] == 1
+    assert lc["staleness_p99_s"] > 0
+    assert lc["sla_misses"] == 1
+    assert lc["reuse_decay"]
+    txt = obs_report.render_text(rep, [], None)
+    assert "lifecycle:" in txt and "SLA MISS" in txt
+    # The SLA-miss health event lands in the warnings block.
+    assert any("health.staleness" in w
+               for w in rep.get("warnings", []))
+    # Staleness + delta-size regressions diff-flag vs a bench row.
+    flags = obs_report.diff_bench(
+        rep, {"staleness_p99_s": lc["staleness_p99_s"] / 10})
+    assert any("staleness regression" in f for f in flags)
+    rep2 = {"lifecycle": {"delta_bytes_frac": 0.5}}
+    flags2 = obs_report.diff_bench(rep2, {"delta_bytes_frac": 0.1})
+    assert any("delta-artifact size regression" in f for f in flags2)
+
+
+def test_bench_gate_gates_lifecycle_metrics():
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    try:
+        import bench_gate
+    finally:
+        sys.path.pop(0)
+    assert bench_gate.GATED_METRICS["staleness_p99_s"][0] == "lower"
+    assert bench_gate.GATED_METRICS["delta_bytes_frac"][0] == "lower"
+    row = bench_gate.summarize(
+        {"platform": "cpu", "metric": "lifecycle drift-walk",
+         "staleness_p99_s": 5.0, "delta_bytes_frac": 0.1,
+         "drift_generations": 20, "reuse_decay": [1.0, 0.9]},
+        "BENCH_drift_r01.json", mtime=1.0)
+    assert row["staleness_p99_s"] == 5.0
+    assert row["reuse_decay"] == [1.0, 0.9]
+    hist = [{"platform": "cpu", "source": "old.json",
+             "staleness_p99_s": 5.0, "delta_bytes_frac": 0.1}]
+    flags, _ = bench_gate.gate(
+        dict(row, staleness_p99_s=20.0, delta_bytes_frac=0.4), hist)
+    assert any("staleness_p99_s" in f for f in flags)
+    assert any("delta_bytes_frac" in f for f in flags)
+
+
+# -- CLI surface -----------------------------------------------------------
+
+
+def test_serve_rebuild_cli_requires_artifacts_root():
+    from explicit_hybrid_mpc_tpu.main import main
+
+    with pytest.raises(SystemExit):
+        main(["serve-rebuild", "-e", "double_integrator"])
+
+
+def test_lifecycle_config_validates():
+    with pytest.raises(ValueError, match="poll_s"):
+        LifecycleConfig(poll_s=0)
+    with pytest.raises(ValueError, match="max_concurrent"):
+        LifecycleConfig(max_concurrent=0)
+    with pytest.raises(ValueError, match="full_every"):
+        LifecycleConfig(full_every=-1)

@@ -234,19 +234,11 @@ def write_delta_artifact(tree, roots, delta_dir: str, base_dir: str,
         base_desc.close()
 
 
-def apply_delta(delta_dir: str, base_dir: str, out_dir: str,
-                verify_base_checksums: bool = False) -> dict:
-    """Reconstruct the FULL serving artifact at `out_dir` from a delta
-    + its base.  Returns the delta meta.  The result is bitwise the
-    publisher's table (content sha256s enforced; DeltaMismatch on a
-    wrong base, CorruptArtifact on a torn delta or hash miss) and
-    loads through ``ControllerRegistry.load_artifacts`` like any full
-    artifact.  ``verify_base_checksums`` additionally re-hashes the
-    base's field files against ITS meta (a full read -- deploy-time
-    paranoia)."""
-    from explicit_hybrid_mpc_tpu.online import descent as descent_mod
-    from explicit_hybrid_mpc_tpu.online import export as export_mod
-    from explicit_hybrid_mpc_tpu.online.descent import DescentTable
+def _validate_delta_base(delta_dir: str, base_dir: str) -> dict:
+    """Shared front-half validation for delta consumers: the delta is
+    committed, its kind is known, and the base at `base_dir` is the
+    generation it was built against (row count + provenance stamp).
+    Returns the delta meta."""
     from explicit_hybrid_mpc_tpu.utils import atomic
 
     meta = _read_meta(delta_dir, DELTA_META)
@@ -275,6 +267,83 @@ def apply_delta(delta_dir: str, base_dir: str, out_dir: str,
             f"base at {base_dir} carries a different provenance stamp "
             "than the delta's recorded base: wrong base generation "
             "(sync the full artifact)")
+    return meta
+
+
+def load_delta_plan(delta_dir: str, base_dir: str) -> dict:
+    """Load the LEAF-ROW plan of a committed delta for device-resident
+    consumers (serve/arena.py): which new rows are verbatim copies of
+    base rows (gatherable in place on device) and the fresh rows' f64
+    payloads (the only host->device upload a hot swap needs).
+
+    Runs the same base validation as ``apply_delta`` (commit marker,
+    kind, base generation by row count + provenance) but loads ONLY the
+    O(changed) delta files -- neither the base table nor the descent
+    arrays are touched, because the arena's fused kernel locates by
+    brute leaf-tile streaming, not tree descent.  The bitwise proof
+    (content sha256 of the full reconstructed arrays) needs the base
+    rows and therefore lives on the ``apply_delta`` disk path; the
+    arena's equivalent guarantee is structural -- kept columns are
+    device-gathered from the already-resident base extent, and the
+    f64->f32 column pack is elementwise, so delta-apply into the arena
+    is bitwise a full re-pack of the reconstructed table (tests pin
+    this).
+
+    Returns ``{"meta", "n_leaves", "base_n_leaves", "base_version",
+    "src_idx", "fresh": {field: rows}}`` with fresh rows aligned to
+    ``np.flatnonzero(src_idx < 0)``.
+    """
+    from explicit_hybrid_mpc_tpu.utils import atomic
+
+    meta = _validate_delta_base(delta_dir, base_dir)
+    p = os.path.join(delta_dir, "src_idx.npy")
+    try:
+        src_idx = np.load(p)
+    except (OSError, ValueError, EOFError) as e:
+        raise atomic.CorruptArtifact(
+            f"{p}: unreadable delta field ({e}); re-sync") from e
+    L = int(meta["n_leaves"])
+    if src_idx.shape[0] != L:
+        raise atomic.CorruptArtifact(
+            f"{delta_dir}: src_idx holds {src_idx.shape[0]} rows but "
+            f"the marker committed {L}: torn delta")
+    n_fresh = int((src_idx < 0).sum())
+    fresh = {}
+    for k in ("bary_M", "U", "V", "node_id"):
+        fp = os.path.join(delta_dir, f"fresh_{k}.npy")
+        try:
+            rows = np.load(fp)
+        except (OSError, ValueError, EOFError) as e:
+            raise atomic.CorruptArtifact(
+                f"{fp}: unreadable delta field ({e}); re-sync") from e
+        if rows.shape[0] != n_fresh:
+            raise atomic.CorruptArtifact(
+                f"{fp}: {rows.shape[0]} fresh rows but src_idx marks "
+                f"{n_fresh}: torn delta")
+        fresh[k] = rows
+    return {"meta": meta, "n_leaves": L,
+            "base_n_leaves": int(meta["base_n_leaves"]),
+            "base_version": meta.get("base_version"),
+            "src_idx": np.asarray(src_idx, dtype=np.int64),
+            "fresh": fresh}
+
+
+def apply_delta(delta_dir: str, base_dir: str, out_dir: str,
+                verify_base_checksums: bool = False) -> dict:
+    """Reconstruct the FULL serving artifact at `out_dir` from a delta
+    + its base.  Returns the delta meta.  The result is bitwise the
+    publisher's table (content sha256s enforced; DeltaMismatch on a
+    wrong base, CorruptArtifact on a torn delta or hash miss) and
+    loads through ``ControllerRegistry.load_artifacts`` like any full
+    artifact.  ``verify_base_checksums`` additionally re-hashes the
+    base's field files against ITS meta (a full read -- deploy-time
+    paranoia)."""
+    from explicit_hybrid_mpc_tpu.online import descent as descent_mod
+    from explicit_hybrid_mpc_tpu.online import export as export_mod
+    from explicit_hybrid_mpc_tpu.online.descent import DescentTable
+    from explicit_hybrid_mpc_tpu.utils import atomic
+
+    meta = _validate_delta_base(delta_dir, base_dir)
     base_table = export_mod.load_leaf_table(
         base_dir, mmap=True, verify_checksum=verify_base_checksums)
 

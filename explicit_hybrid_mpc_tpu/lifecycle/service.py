@@ -133,11 +133,16 @@ class RebuildService:
 
     def __init__(self, source: RevisionSource, build_cfg: PartitionConfig,
                  cfg: LifecycleConfig | None = None, registry=None,
-                 prior=None, obs: "obs_lib.Obs | None" = None):
+                 prior=None, obs: "obs_lib.Obs | None" = None,
+                 arena=None):
         self.source = source
         self.build_cfg = build_cfg
         self.cfg = cfg or LifecycleConfig()
         self.registry = registry
+        #: Optional serve.DeviceArena: each published generation also
+        #: hot-swaps into the device-resident arena (delta generations
+        #: via the O(changed) publish_delta path).
+        self.arena = arena
         self.obs = obs if obs is not None else obs_lib.NOOP
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
@@ -500,6 +505,21 @@ class RebuildService:
         if self.registry is not None:
             self.registry.load_artifacts(name, version, full_dir,
                                          expect_provenance=stamp)
+        if self.arena is not None:
+            # Device-resident fleet path: delta generations swap in
+            # O(changed) (kept columns device-gathered from the
+            # resident base extent); anything the arena cannot delta
+            # against (first generation, non-resident base) loads full.
+            try:
+                if published == "delta":
+                    self.arena.publish_delta(
+                        name, version, full_dir + ".delta", base_dir)
+                else:
+                    self.arena.publish_from_artifacts(
+                        name, version, full_dir)
+            except delta_mod.DeltaMismatch:
+                self.arena.publish_from_artifacts(name, version,
+                                                  full_dir)
         with self._lock:
             st.prior_dir = full_dir
             st.prior_version = version

@@ -24,7 +24,20 @@ Point location runs in f32: TPU has no native f64, and containment scores
 only *select* a leaf (ties at shared faces are resolved either way to the
 same interpolated law on conforming meshes).  The interpolation then uses
 the f64 tables.  Tests cross-check against the f64 pure-JAX evaluator.
+
+PR 16 adds the FUSED serving kernel (`arena_eval_fused`): point location
++ barycentric affine evaluation + certified-box fallback clamp in ONE
+``pallas_call``, so a serving request never round-trips to the host
+between locate and eval.  It consumes ARENA-layout buffers (serve/
+arena.py: many controllers' leaf tables packed column-wise into shared
+padded f32 buffers) with per-row column extents, so one launch serves a
+MIXED-TENANT micro-batch: each row's argmax is masked to its own
+controller's columns.  Evaluation stays f32 in-kernel (the f64 gather
+path in evaluator.py remains the reference/parity path; values agree to
+f32 interpolation accuracy, leaf ids exactly on tie-free queries --
+tests/test_pallas_fused.py documents the f32-locate tie caveat).
 """
+# tpulint: x32-module
 
 from __future__ import annotations
 
@@ -206,3 +219,266 @@ def evaluate(ptable: PallasLeafTable, dev_table, thetas: jax.Array,
     cost = jnp.einsum("bi,bi->b", lam, dev_table.V[leaf])
     inside = score >= -tol
     return EvalResult(u=u, cost=cost, leaf=leaf, inside=inside)
+
+
+# ---------------------------------------------------------------------------
+# Fused serving kernel: clamp -> locate -> evaluate in one pallas_call over
+# arena-layout buffers (serve/arena.py).  One launch serves a mixed-tenant
+# micro-batch: per-row column extents mask the argmax to each row's own
+# controller.
+# ---------------------------------------------------------------------------
+
+# Padded control-input width of the arena U buffer (lane dimension).
+_NU = 128
+
+
+def pack_columns(table: LeafTable, n_cols: int, PV: int, K: int,
+                 nu: int = _NU) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Pack a LeafTable into ``n_cols`` arena columns (host-side, f32).
+
+    Returns (bary (PV, K, n_cols), U (PV, n_cols, nu), V (PV, n_cols)).
+    Padded vertices carry +_BIG at the homogeneous column (never the min)
+    with zero U/V rows (exact-zero contribution to the one-hot gather);
+    pad columns past the table's leaves carry -_BIG (never the argmax).
+    The f64 -> f32 cast is elementwise, so packing rows gathered from an
+    existing arena extent is bitwise identical to packing from the f64
+    table -- the property lifecycle delta-apply into the arena relies on.
+    """
+    L, pp1, _ = table.bary_M.shape
+    p = pp1 - 1
+    if L > n_cols:
+        raise ValueError(f"table has {L} leaves > {n_cols} columns")
+    if table.U.shape[2] > nu:
+        raise ValueError(f"n_u={table.U.shape[2]} exceeds arena lane pad {nu}")
+    bary = np.zeros((PV, K, n_cols), dtype=np.float32)
+    bary[:pp1, :pp1, :L] = np.ascontiguousarray(
+        table.bary_M.transpose(1, 2, 0), dtype=np.float32)
+    bary[pp1:, p, :L] = _BIG
+    bary[:, p, L:] = -_BIG
+    U = np.zeros((PV, n_cols, nu), dtype=np.float32)
+    U[:pp1, :L, :table.U.shape[2]] = np.ascontiguousarray(
+        table.U.transpose(1, 0, 2), dtype=np.float32)
+    V = np.zeros((PV, n_cols), dtype=np.float32)
+    V[:pp1, :L] = np.ascontiguousarray(table.V.T, dtype=np.float32)
+    return bary, U, V
+
+
+def _fused_kernel(th_ref, lb_ref, ub_ref, ext_ref, bary_ref, u_ref, v_ref,
+                  val_ref, idx_ref, u_out_ref, cost_ref, clamp_ref,
+                  best_val, best_idx, best_u, best_cost):
+    """One (query tile, leaf tile) step: clamp, score, running argmax,
+    and the candidate one-hot evaluation -- all in VMEM.
+
+    ext_ref lanes 0/1 hold each row's [start, end) column extent (relative
+    to the streamed buffers); columns outside it are masked to -_BIG so a
+    row never selects another tenant's leaf.  The one-hot gather
+    ``sum_i (onehot * lam_i) @ U_i`` adds exact zeros off the selected
+    column, so it is bitwise a gather of the f32 arena rows.
+    """
+    lt = pl.program_id(1)
+    th = th_ref[:]                                    # (TB, K) homogeneous
+    thc = jnp.clip(th, lb_ref[:], ub_ref[:])          # certified-box clamp
+
+    @pl.when(lt == 0)
+    def _():
+        best_val[:] = jnp.full_like(best_val, -jnp.inf)
+        best_idx[:] = jnp.zeros_like(best_idx)
+        best_u[:] = jnp.zeros_like(best_u)
+        best_cost[:] = jnp.zeros_like(best_cost)
+        moved = jnp.any(th != thc, axis=1, keepdims=True)      # (TB, 1)
+        clamp_ref[:] = jnp.broadcast_to(
+            moved, clamp_ref.shape).astype(jnp.int32)
+
+    PV = bary_ref.shape[0]
+    score = jnp.full((th.shape[0], _TL), _BIG, dtype=jnp.float32)
+    lams = []
+    for i in range(PV):                               # PV is static & small
+        lam_i = jnp.dot(thc, bary_ref[i],
+                        preferred_element_type=jnp.float32,
+                        precision=jax.lax.Precision.HIGHEST)   # (TB, TL)
+        lams.append(lam_i)
+        score = jnp.minimum(score, lam_i)
+
+    # Mask columns outside the row's controller extent.  Constants are
+    # explicitly f32/i32: in interpret mode the kernel body is traced at
+    # pallas_call lowering time, OUTSIDE the caller's enable_x64(False)
+    # window, so a bare python float would lower as f64.
+    col = jax.lax.broadcasted_iota(jnp.int32, score.shape, 1) + lt * _TL
+    live = (col >= ext_ref[:, 0:1]) & (col < ext_ref[:, 1:2])
+    score = jnp.where(live, score, jnp.float32(-_BIG))
+
+    # First-match argmax within the tile.  An all-masked tile yields
+    # tile_max == -_BIG and tile_idx == the tile's first column; the
+    # candidate only survives until any live tile beats it (real scores
+    # are >> -_BIG), and rows with an empty extent are host-discarded.
+    iota = jax.lax.broadcasted_iota(jnp.int32, score.shape, 1)
+    tile_max = jnp.max(score, axis=1, keepdims=True)           # (TB, 1)
+    in_tile = jnp.min(jnp.where(score == tile_max, iota, jnp.int32(2**30)),
+                      axis=1, keepdims=True)
+    tile_idx = jnp.where(in_tile == jnp.int32(2**30), jnp.int32(0),
+                         in_tile) + lt * _TL
+
+    # Candidate evaluation at the tile's winning column: one-hot weights
+    # turn the gather into PV small MXU matmuls.
+    onehot = (iota == (tile_idx - lt * _TL)).astype(jnp.float32)  # (TB, TL)
+    u_cand = jnp.zeros((th.shape[0], u_ref.shape[2]), dtype=jnp.float32)
+    cost_cand = jnp.zeros((th.shape[0], 1), dtype=jnp.float32)
+    for i in range(PV):
+        w_i = onehot * lams[i]                        # (TB, TL)
+        u_cand = u_cand + jnp.dot(w_i, u_ref[i],
+                                  preferred_element_type=jnp.float32,
+                                  precision=jax.lax.Precision.HIGHEST)
+        cost_cand = cost_cand + jnp.sum(w_i * v_ref[i][None, :],
+                                        axis=1, keepdims=True)
+
+    # Strict > keeps the earliest tile on cross-tile ties.
+    shape = best_val.shape
+    better1 = tile_max > best_val[:, 0:1]                      # (TB, 1)
+    better = jnp.broadcast_to(better1, shape)
+    best_val[:] = jnp.where(better, jnp.broadcast_to(tile_max, shape),
+                            best_val[:])
+    best_idx[:] = jnp.where(better, jnp.broadcast_to(tile_idx, shape),
+                            best_idx[:])
+    best_u[:] = jnp.where(jnp.broadcast_to(better1, best_u.shape),
+                          u_cand, best_u[:])
+    best_cost[:] = jnp.where(better, jnp.broadcast_to(cost_cand, shape),
+                             best_cost[:])
+
+    @pl.when(lt == pl.num_programs(1) - 1)
+    def _():
+        val_ref[:] = best_val[:]
+        idx_ref[:] = best_idx[:]
+        u_out_ref[:] = best_u[:]
+        cost_ref[:] = best_cost[:]
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def arena_eval_fused(bary, u_buf, v_buf, th1, lb1, ub1, ext,
+                     interpret: bool = False):
+    """Fused clamp+locate+eval over arena-layout buffers.
+
+    bary (PV, K, C) / u_buf (PV, C, NU) / v_buf (PV, C): f32 arena slices,
+    C a multiple of _TL.  th1/lb1/ub1 (Bpad, K): f32 homogeneous queries
+    and per-row clamp boxes (column p is 1.0 in all three so the clamp is
+    the identity there; K-pad columns are 0).  ext (Bpad, 2) i32: per-row
+    [start, end) column extent relative to the buffers.
+
+    Returns (val (Bpad,) f32, col (Bpad,) i32, u (Bpad, NU) f32,
+    cost (Bpad,) f32, clamped (Bpad,) bool).
+    """
+    Bpad, K = th1.shape
+    PV, _, C = bary.shape
+    ext128 = jnp.zeros((Bpad, 128), dtype=jnp.int32)
+    ext128 = ext128.at[:, 0:2].set(ext.astype(jnp.int32))
+    grid = (Bpad // _TB, C // _TL)
+    with _enable_x64(False):
+        val, idx, u, cost, clamp = _fused_call(
+            grid, PV, K, th1, lb1, ub1, ext128, bary, u_buf, v_buf,
+            interpret)
+    return val[:, 0], idx[:, 0], u, cost[:, 0], clamp[:, 0] != 0
+
+
+def _fused_call(grid, PV, K, th1, lb1, ub1, ext128, bary, u_buf, v_buf,
+                interpret):
+    Bpad = th1.shape[0]
+    NU = u_buf.shape[2]
+    row_spec = pl.BlockSpec((_TB, K), lambda b, lt: (b, 0),
+                            memory_space=pltpu.VMEM)
+    out_spec = pl.BlockSpec((_TB, 128), lambda b, lt: (b, 0),
+                            memory_space=pltpu.VMEM)
+    return pl.pallas_call(
+        _fused_kernel,
+        grid=grid,
+        in_specs=[
+            row_spec,                                          # th1
+            row_spec,                                          # lb1
+            row_spec,                                          # ub1
+            pl.BlockSpec((_TB, 128), lambda b, lt: (b, 0),
+                         memory_space=pltpu.VMEM),             # ext
+            pl.BlockSpec((PV, K, _TL), lambda b, lt: (0, 0, lt),
+                         memory_space=pltpu.VMEM),             # bary
+            pl.BlockSpec((PV, _TL, NU), lambda b, lt: (0, lt, 0),
+                         memory_space=pltpu.VMEM),             # U
+            pl.BlockSpec((PV, _TL), lambda b, lt: (0, lt),
+                         memory_space=pltpu.VMEM),             # V
+        ],
+        out_specs=[
+            out_spec,                                          # val
+            out_spec,                                          # idx
+            pl.BlockSpec((_TB, NU), lambda b, lt: (b, 0),
+                         memory_space=pltpu.VMEM),             # u
+            out_spec,                                          # cost
+            out_spec,                                          # clamped
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((Bpad, 128), jnp.float32),
+            jax.ShapeDtypeStruct((Bpad, 128), jnp.int32),
+            jax.ShapeDtypeStruct((Bpad, NU), jnp.float32),
+            jax.ShapeDtypeStruct((Bpad, 128), jnp.float32),
+            jax.ShapeDtypeStruct((Bpad, 128), jnp.int32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((_TB, 128), jnp.float32),
+            pltpu.VMEM((_TB, 128), jnp.int32),
+            pltpu.VMEM((_TB, NU), jnp.float32),
+            pltpu.VMEM((_TB, 128), jnp.float32),
+        ],
+        interpret=interpret,
+    )(th1, lb1, ub1, ext128, bary, u_buf, v_buf)
+
+
+@jax.jit
+def arena_eval_xla(baryT, u_buf, v_buf, q, ext):
+    """Plain-XLA twin of `arena_eval_fused`: identical semantics over the
+    SAME f32 arena buffers (clamp, extent masking, first-match argmax,
+    one-hot-equivalent gather), no Pallas.  This is the CPU serving path:
+    interpret-mode Pallas re-simulates the grid per launch and is far too
+    slow for a latency bench, while this path jit-compiles to the same
+    f32 arithmetic.  Same returns as `arena_eval_fused`.
+
+    Unlike the Pallas path the caller passes FULL resident buffers with
+    ABSOLUTE extents: slicing a column window out first would copy the
+    (PV, C, NU) payload buffer every launch, and the only per-launch
+    O(C) work left -- the location sgemm -- is cheap next to that copy.
+
+    `baryT` is the arena's LOCATION-LAYOUT twin of the kernel-layout
+    bary buffer: shape (K, pp1, C) with pp1 = p + 1 live vertex rows
+    only, maintained at publish time (serve/arena.py).  Rows pp1..PV of
+    the kernel buffer are lane padding (+BIG scores that never win the
+    min, exactly-zero payloads that add nothing to the interpolation),
+    so dropping them costs nothing semantically and at PV=8, p=2 is
+    2.7x less location work per launch; keeping the transpose resident
+    saves a further O(C) copy every call.  The Pallas kernel keeps the
+    full-PV (PV, K, C) layout -- its tiles are already lane-shaped.
+
+    `q` stacks the per-row query planes [th1; lb1; ub1] into ONE
+    (3, B, K) f32 array so the caller pays a single host->device
+    transfer per launch instead of three (transfer DISPATCH, not
+    bytes, is what shows up at micro-batch sizes).
+    """
+    with _enable_x64(False):
+        th1, lb1, ub1 = q[0], q[1], q[2]
+        B, K = th1.shape
+        _, pp1, C = baryT.shape
+        thc = jnp.clip(th1, lb1, ub1)
+        clamped = jnp.any(th1 != thc, axis=1)
+        # lam[b, i*C + c] = thc[b] . bary[i, :, c] as ONE sgemm (the
+        # einsum form lowers to a batched dot that bypasses BLAS).
+        lam = jnp.dot(thc, baryT.reshape(K, pp1 * C),
+                      preferred_element_type=jnp.float32,
+                      precision=jax.lax.Precision.HIGHEST
+                      ).reshape(B, pp1, C)
+        score = jnp.min(lam, axis=1)                           # (B, C)
+        col = jnp.arange(C, dtype=jnp.int32)
+        live = (col[None, :] >= ext[:, 0:1]) & (col[None, :] < ext[:, 1:2])
+        score = jnp.where(live, score, jnp.float32(-_BIG))
+        best = jnp.argmax(score, axis=1).astype(jnp.int32)     # first match
+        val = jnp.take_along_axis(score, best[:, None], axis=1)[:, 0]
+        lam_best = jnp.take_along_axis(
+            lam, best[:, None, None], axis=2)[:, :, 0]         # (B, pp1)
+        u_best = jnp.swapaxes(u_buf[:pp1, best, :], 0, 1)      # (B, pp1, NU)
+        u = jnp.einsum("bi,bin->bn", lam_best, u_best,
+                       preferred_element_type=jnp.float32,
+                       precision=jax.lax.Precision.HIGHEST)
+        cost = jnp.sum(lam_best * v_buf[:pp1, best].T, axis=1)
+        return val, best, u, cost, clamped

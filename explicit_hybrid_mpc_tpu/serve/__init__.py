@@ -34,8 +34,10 @@ Architecture + tuning: docs/serving.md.
 
 from __future__ import annotations
 
+from explicit_hybrid_mpc_tpu.serve.arena import (  # noqa: F401
+    ArenaEvalResult, ArenaExtent, ArenaFull, DeviceArena)
 from explicit_hybrid_mpc_tpu.serve.fallback import FallbackPolicy  # noqa: F401
 from explicit_hybrid_mpc_tpu.serve.registry import (  # noqa: F401
     ControllerRegistry, ControllerVersion, root_box, save_artifacts)
 from explicit_hybrid_mpc_tpu.serve.scheduler import (  # noqa: F401
-    RequestScheduler, ServeResult)
+    ArenaScheduler, RequestScheduler, ServeResult)

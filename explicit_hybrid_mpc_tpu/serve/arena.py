@@ -1,0 +1,585 @@
+"""Device-resident cross-controller leaf arena (ISSUE 16 tentpole).
+
+Per-controller serving keeps one staged leaf table per
+ControllerVersion and pays one device dispatch per controller per
+micro-batch; with the lifecycle daemon minting a new version per drift
+revision and the fleet multiplying controller count, host dispatch --
+not the model -- is the scaling wall (BENCH_serve_r01: 5.65 ms p99
+against 0.86 us/query of raw descent).  The arena packs MANY
+controllers' leaf tables into ONE set of shared padded f32 device
+buffers so a single fused-kernel launch (online/pallas_eval.py:
+``arena_eval_fused``) serves a mixed-tenant micro-batch:
+
+- ``bary`` (PV, K, C): column c holds one leaf's transposed
+  barycentric matrix (pallas_eval.pack_columns layout; -BIG marks
+  unowned columns so they can never win an argmax);
+- ``U`` (PV, C, NU) / ``V`` (PV, C): the vertex input/cost payloads;
+- a per-controller DIRECTORY of column extents [start, start+n_cols):
+  each request row carries its controller's extent into the kernel,
+  which masks the location argmax to those columns -- per-row routing
+  replaces per-controller dispatch.
+
+Residency limits: one arena holds tables of a single parameter
+dimension ``p`` (the kernel contraction width K is shared) and
+``n_u <= NU`` (the padded lane width); capacity is fixed at
+construction (``capacity_cols``) and exhaustion raises ``ArenaFull``
+rather than silently evicting a tenant.
+
+Hot swap mirrors the registry's two-epoch handoff: publishing a new
+version writes the new columns (previously free -- no live reader),
+then flips the directory entry; the old extent retires only when its
+last leased batch drains.  In-flight launches are additionally safe by
+construction: jax arrays are immutable, so a launch holds the buffer
+snapshot it was dispatched with.  ``publish_delta`` consumes the
+bitwise-pinned lifecycle/delta.py artifacts in O(changed) host->device
+traffic: kept rows are device-gathered from the base extent, only
+fresh rows are uploaded (the f64->f32 pack is elementwise, so the
+result is bitwise a full re-pack -- tests/test_arena.py pins it).
+
+Backends: ``pallas`` (the fused kernel; Mosaic on TPU, interpret mode
+for parity tests) and ``xla`` (``arena_eval_xla``: the same f32
+semantics over the same buffers in plain jitted JAX -- the CPU serving
+path, where re-simulating the Pallas grid per launch would swamp a
+latency budget).  docs/serving.md#device-resident-arena documents the
+layout and protocol.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+import threading
+import time
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from explicit_hybrid_mpc_tpu import obs as obs_lib
+from explicit_hybrid_mpc_tpu.online import export as export_mod
+from explicit_hybrid_mpc_tpu.online import pallas_eval
+from explicit_hybrid_mpc_tpu.online.export import LeafTable
+
+_TL = pallas_eval._TL
+_TB = pallas_eval._TB
+_NU = pallas_eval._NU
+_BIG = pallas_eval._BIG
+
+#: Default kernel tolerance: f32 containment scores (the f64 reference
+#: path uses 1e-9; see online/pallas_eval.evaluate).
+DEFAULT_TOL = 1e-4
+
+
+class ArenaFull(RuntimeError):
+    """No free column span fits the table: grow ``capacity_cols`` or
+    evict a tenant explicitly (the arena never evicts on its own)."""
+
+
+def _pow2(n: int) -> int:
+    return max(1, 1 << (max(1, int(n)) - 1).bit_length())
+
+
+def _cdiv(a: int, b: int) -> int:
+    return -(-a // b)
+
+
+class ArenaExtent:
+    """One controller version's column span + serving metadata."""
+
+    __slots__ = ("name", "version", "epoch", "start", "n_cols",
+                 "n_leaves", "n_u", "lb", "ub", "state", "_refs",
+                 "_retired_evt")
+
+    def __init__(self, name, version, epoch, start, n_cols, n_leaves,
+                 n_u, lb, ub):
+        self.name = name
+        self.version = version
+        self.epoch = epoch
+        self.start = start
+        self.n_cols = n_cols
+        self.n_leaves = n_leaves
+        self.n_u = n_u
+        self.lb = np.asarray(lb, dtype=np.float64)
+        self.ub = np.asarray(ub, dtype=np.float64)
+        self.state = "active"
+        self._refs = 0
+        self._retired_evt = threading.Event()
+
+    @property
+    def end(self) -> int:
+        return self.start + self.n_cols
+
+    def __repr__(self):
+        return (f"ArenaExtent({self.name}:{self.version} "
+                f"cols [{self.start}, {self.end}) "
+                f"L={self.n_leaves} {self.state})")
+
+
+class ArenaEvalResult:
+    """One fused launch's outputs, host-side (f32 kernel values).
+
+    ``u`` is lane-padded to the arena's NU -- slice ``[:, :n_u]`` per
+    controller.  ``leaf`` is the controller-LOCAL leaf row (global
+    column minus the row's extent start); ``served`` is the fused
+    clamp+eval verdict (the clamped point landed in a leaf), ``clamped``
+    whether the in-kernel clip moved the query."""
+
+    __slots__ = ("u", "cost", "leaf", "col", "served", "clamped",
+                 "versions", "n_us", "width_cols")
+
+    def __init__(self, u, cost, leaf, col, served, clamped, versions,
+                 n_us, width_cols):
+        self.u = u
+        self.cost = cost
+        self.leaf = leaf
+        self.col = col
+        self.served = served
+        self.clamped = clamped
+        self.versions = versions
+        self.n_us = n_us
+        self.width_cols = width_cols
+
+
+@functools.partial(jax.jit, static_argnames=("width", "interpret"))
+def _eval_window(bary, u_buf, v_buf, th1, lb1, ub1, ext, lo, *,
+                 width: int, interpret: bool):
+    """Pallas path: slice the [lo, lo+width) column window out of the
+    resident buffers (traced start, static pow2-bucketed width:
+    compiled-shape count stays bounded) and run one fused launch over
+    it.  The XLA path deliberately skips this helper -- slicing would
+    copy the (PV, C, NU) payload buffer per launch, so it evaluates the
+    full buffers with absolute extents instead (`arena_eval_xla`)."""
+    PV, K, _ = bary.shape
+    NU = u_buf.shape[2]
+    lo = lo.astype(jnp.int32)
+    z = jnp.zeros((), dtype=jnp.int32)
+    b = jax.lax.dynamic_slice(bary, (z, z, lo), (PV, K, width))
+    u_s = jax.lax.dynamic_slice(u_buf, (z, lo, z), (PV, width, NU))
+    v_s = jax.lax.dynamic_slice(v_buf, (z, lo), (PV, width))
+    ext_rel = ext - lo
+    val, col, u, cost, clamped = pallas_eval.arena_eval_fused(
+        b, u_s, v_s, th1, lb1, ub1, ext_rel, interpret=interpret)
+    return val, col + lo, u, cost, clamped
+
+
+class DeviceArena:
+    """Shared leaf-table buffers + controller directory (module
+    docstring).  Thread-safe: directory mutations and lease counts sit
+    behind one lock; evaluation reads immutable buffer snapshots."""
+
+    def __init__(self, p: int, n_u: int, capacity_cols: int = 4096,
+                 backend: Optional[str] = None, interpret: bool = False,
+                 tol: float = DEFAULT_TOL,
+                 obs: "obs_lib.Obs | None" = None):
+        if capacity_cols % _TL != 0 or capacity_cols <= 0:
+            raise ValueError(
+                f"capacity_cols={capacity_cols} must be a positive "
+                f"multiple of the leaf-tile width {_TL}")
+        if n_u > _NU:
+            raise ValueError(f"n_u={n_u} exceeds the arena lane pad {_NU}")
+        self.p = int(p)
+        self.n_u = int(n_u)
+        self.capacity_cols = int(capacity_cols)
+        pp1 = self.p + 1
+        self.PV = max(8, _pow2(pp1))
+        self.K = 8 * _cdiv(pp1, 8)
+        self.NU = _NU
+        if backend is None:
+            backend = ("pallas" if jax.default_backend() == "tpu"
+                       else "xla")
+        if backend not in ("pallas", "xla"):
+            raise ValueError(f"unknown arena backend {backend!r}")
+        self.backend = backend
+        self.interpret = bool(interpret)
+        self.tol = float(tol)
+        self._obs = obs if obs is not None else obs_lib.NOOP
+        self._lock = threading.RLock()
+        self._active: dict[str, ArenaExtent] = {}
+        self._retiring: list[ArenaExtent] = []
+        self._free: list[tuple[int, int]] = [(0, self.capacity_cols)]
+        self._epoch = 0
+        bary = np.zeros((self.PV, self.K, capacity_cols),
+                        dtype=np.float32)
+        bary[:, self.p, :] = -_BIG        # unowned columns never win
+        self.bary = jnp.asarray(bary)
+        # Location-layout twin of `bary` for the XLA path: live vertex
+        # rows only, contraction dim leading, so each launch is one
+        # sgemm over a resident operand instead of a per-call
+        # transpose+copy of the full kernel-layout buffer.
+        baryT = np.zeros((self.K, self.p + 1, capacity_cols),
+                         dtype=np.float32)
+        baryT[self.p, :, :] = -_BIG
+        self.baryT = jnp.asarray(baryT)
+        self.U = jnp.zeros((self.PV, capacity_cols, self.NU),
+                           dtype=jnp.float32)
+        self.V = jnp.zeros((self.PV, capacity_cols), dtype=jnp.float32)
+        self._ms = None
+        if self._obs.enabled:
+            m = self._obs.metrics
+            self._ms = {
+                "controllers": m.gauge("serve.arena.controllers"),
+                "bytes": m.gauge("serve.arena.resident_bytes"),
+                "free": m.gauge("serve.arena.free_cols"),
+                "swap_us": m.histogram("serve.arena.swap_us"),
+                "publishes": m.counter("serve.arena.publishes"),
+                "deltas": m.counter("serve.arena.delta_publishes"),
+                "launches": m.counter("serve.arena.launches"),
+            }
+
+    # -- directory / allocation -------------------------------------------
+
+    def _col_bytes(self) -> int:
+        # bary + baryT (location-layout twin) + U + V, all f32.
+        return 4 * (self.PV * self.K + self.K * (self.p + 1)
+                    + self.PV * self.NU + self.PV)
+
+    def _alloc(self, n_cols: int) -> int:
+        """First-fit span from the free list (caller holds the lock)."""
+        for i, (start, span) in enumerate(self._free):
+            if span >= n_cols:
+                if span == n_cols:
+                    del self._free[i]
+                else:
+                    self._free[i] = (start + n_cols, span - n_cols)
+                return start
+        occupied = sum(e.n_cols for e in self._active.values())
+        occupied += sum(e.n_cols for e in self._retiring)
+        raise ArenaFull(
+            f"no free span of {n_cols} columns "
+            f"(capacity {self.capacity_cols}, occupied {occupied}, "
+            f"largest free {max((s for _, s in self._free), default=0)}"
+            "): grow capacity_cols or retire a tenant")
+
+    def _release(self, start: int, n_cols: int) -> None:
+        """Return a span to the free list, merging neighbors."""
+        self._free.append((start, n_cols))
+        self._free.sort()
+        merged = []
+        for s, n in self._free:
+            if merged and merged[-1][0] + merged[-1][1] == s:
+                merged[-1] = (merged[-1][0], merged[-1][1] + n)
+            else:
+                merged.append((s, n))
+        self._free = [(s, n) for s, n in merged]
+
+    def _retire(self, ext: ArenaExtent) -> None:
+        """Caller holds the lock; refs have drained."""
+        ext.state = "retired"
+        self._release(ext.start, ext.n_cols)
+        if ext in self._retiring:
+            self._retiring.remove(ext)
+        ext._retired_evt.set()
+        self._gauges()
+
+    def _gauges(self) -> None:
+        if not self._ms:
+            return
+        with_cols = list(self._active.values()) + self._retiring
+        self._ms["controllers"].set(len(self._active))
+        self._ms["bytes"].set(
+            sum(e.n_cols for e in with_cols) * self._col_bytes())
+        self._ms["free"].set(sum(s for _, s in self._free))
+
+    # -- publish ----------------------------------------------------------
+
+    def _write_extent(self, bary_blk, u_blk, v_blk, start, n_cols):
+        sl = slice(start, start + n_cols)
+        self.bary = self.bary.at[:, :, sl].set(bary_blk)
+        self.baryT = self.baryT.at[:, :, sl].set(
+            jnp.transpose(jnp.asarray(bary_blk)[: self.p + 1], (1, 0, 2)))
+        self.U = self.U.at[:, sl, :].set(u_blk)
+        self.V = self.V.at[:, sl].set(v_blk)
+
+    def _install(self, name, version, bary_blk, u_blk, v_blk, n_leaves,
+                 n_u, lb, ub, t0, delta=False) -> ArenaExtent:
+        n_cols = bary_blk.shape[2]
+        with self._lock:
+            old = self._active.get(name)
+            if old is not None and old.version == version:
+                raise ValueError(
+                    f"{name}: version {version!r} is already resident")
+            start = self._alloc(n_cols)
+            # Columns were free: no live reader.  Write the buffers
+            # BEFORE flipping the directory (new leases must only ever
+            # see fully-written columns).
+            self._write_extent(bary_blk, u_blk, v_blk, start, n_cols)
+            self._epoch += 1
+            ext = ArenaExtent(name, version, self._epoch, start, n_cols,
+                              n_leaves, n_u, lb, ub)
+            self._active[name] = ext
+            if old is not None:
+                old.state = "retiring"
+                if old._refs == 0:
+                    self._retire(old)
+                else:
+                    self._retiring.append(old)
+            self._gauges()
+        swap_us = (time.perf_counter() - t0) * 1e6
+        if self._ms:
+            self._ms["swap_us"].observe(swap_us)
+            self._ms["deltas" if delta else "publishes"].inc()
+        self._obs.event("serve.arena.swap", controller=name,
+                        version=version, start=start, n_cols=n_cols,
+                        n_leaves=n_leaves, delta=bool(delta),
+                        swap_us=swap_us)
+        return ext
+
+    def publish(self, name: str, version: str, table: LeafTable,
+                lb: np.ndarray, ub: np.ndarray) -> ArenaExtent:
+        """Pack a full leaf table into fresh columns and flip the
+        directory entry (two-epoch: any previous version retires when
+        its leases drain).  `lb`/`ub`: the certified parameter box the
+        kernel clamps to (serve.registry.root_box recovers it from a
+        descent artifact)."""
+        t0 = time.perf_counter()
+        L, pp1, _ = table.bary_M.shape
+        if pp1 - 1 != self.p:
+            raise ValueError(
+                f"{name}: table has p={pp1 - 1}, arena holds p={self.p} "
+                "(one arena serves one parameter dimension)")
+        n_cols = _TL * _cdiv(L, _TL)
+        bary_blk, u_blk, v_blk = pallas_eval.pack_columns(
+            table, n_cols, self.PV, self.K, self.NU)
+        return self._install(name, version, bary_blk, u_blk, v_blk, L,
+                             int(table.U.shape[2]), lb, ub, t0)
+
+    def publish_from_artifacts(self, name: str, version: str,
+                               dir_path: str) -> ArenaExtent:
+        """Publish from a save_artifacts directory (leaf table + descent
+        npz; the box comes from the descent root simplices)."""
+        from explicit_hybrid_mpc_tpu.online.descent import load_descent
+        from explicit_hybrid_mpc_tpu.serve.registry import root_box
+        import os
+
+        table = export_mod.load_leaf_table(dir_path, mmap=True)
+        dt = load_descent(os.path.join(dir_path, "descent.npz"))
+        lb, ub = root_box(dt)
+        return self.publish(name, version, table, lb, ub)
+
+    def publish_delta(self, name: str, version: str, delta_dir: str,
+                      base_dir: str) -> ArenaExtent:
+        """O(changed) hot swap from a lifecycle/delta.py artifact.
+
+        Kept rows are gathered ON DEVICE from the resident base extent
+        (their f32 columns are bitwise the base pack); only fresh rows
+        cross the host->device boundary.  Requires the base version to
+        still be the active extent (DeltaMismatch otherwise) and
+        transiently needs room for BOTH extents (two-epoch handoff).
+        """
+        from explicit_hybrid_mpc_tpu.lifecycle import delta as delta_mod
+
+        t0 = time.perf_counter()
+        plan = delta_mod.load_delta_plan(delta_dir, base_dir)
+        with self._lock:
+            base = self._active.get(name)
+            if base is None:
+                raise delta_mod.DeltaMismatch(
+                    f"{name}: no resident base extent to delta against")
+            if plan["base_version"] is not None and \
+                    base.version != plan["base_version"]:
+                raise delta_mod.DeltaMismatch(
+                    f"{name}: resident version {base.version!r} is not "
+                    f"the delta's base {plan['base_version']!r}")
+            if base.n_leaves != plan["base_n_leaves"]:
+                raise delta_mod.DeltaMismatch(
+                    f"{name}: resident extent has {base.n_leaves} "
+                    f"leaves, delta base has {plan['base_n_leaves']}")
+            base_start = base.start
+        src_idx = plan["src_idx"]
+        L = plan["n_leaves"]
+        n_cols = _TL * _cdiv(L, _TL)
+        # Device gather of kept columns (fresh positions point at a
+        # dummy column and are overwritten below).
+        gather = np.where(src_idx >= 0, base_start + src_idx,
+                          base_start).astype(np.int32)
+        bary_blk = self.bary[:, :, gather]
+        u_blk = self.U[:, gather, :]
+        v_blk = self.V[:, gather]
+        fresh_pos = np.flatnonzero(src_idx < 0).astype(np.int32)
+        if fresh_pos.size:
+            ft = LeafTable(
+                bary_M=plan["fresh"]["bary_M"], U=plan["fresh"]["U"],
+                V=plan["fresh"]["V"],
+                delta=np.zeros(fresh_pos.size, dtype=np.int64),
+                node_id=plan["fresh"]["node_id"])
+            fb, fu, fv = pallas_eval.pack_columns(
+                ft, fresh_pos.size, self.PV, self.K, self.NU)
+            bary_blk = bary_blk.at[:, :, fresh_pos].set(fb)
+            u_blk = u_blk.at[:, fresh_pos, :].set(fu)
+            v_blk = v_blk.at[:, fresh_pos].set(fv)
+        if n_cols > L:   # pad columns: never the argmax
+            pad = np.zeros((self.PV, self.K, n_cols - L),
+                           dtype=np.float32)
+            pad[:, self.p, :] = -_BIG
+            bary_blk = jnp.concatenate([bary_blk, jnp.asarray(pad)],
+                                       axis=2)
+            u_blk = jnp.concatenate(
+                [u_blk, jnp.zeros((self.PV, n_cols - L, self.NU),
+                                  dtype=jnp.float32)], axis=1)
+            v_blk = jnp.concatenate(
+                [v_blk, jnp.zeros((self.PV, n_cols - L),
+                                  dtype=jnp.float32)], axis=1)
+        n_u = int(plan["meta"].get("n_u", self.n_u))
+        ext = self._install(name, version, bary_blk, u_blk, v_blk, L,
+                            n_u, base.lb, base.ub, t0, delta=True)
+        return ext
+
+    # -- leases / lifecycle ------------------------------------------------
+
+    @contextlib.contextmanager
+    def lease(self, names):
+        """Pin the ACTIVE extents of `names` for one batch (two-epoch:
+        a retiring extent frees its columns only after the last lease
+        drains).  Yields {name: ArenaExtent}."""
+        names = sorted(set(names))
+        with self._lock:
+            exts = {}
+            for n in names:
+                ext = self._active.get(n)
+                if ext is None:
+                    raise KeyError(
+                        f"controller {n!r} is not resident in the arena")
+                exts[n] = ext
+            for ext in exts.values():
+                ext._refs += 1
+        try:
+            yield exts
+        finally:
+            with self._lock:
+                for ext in exts.values():
+                    ext._refs -= 1
+                    if ext.state == "retiring" and ext._refs == 0:
+                        self._retire(ext)
+
+    def retire(self, name: str) -> None:
+        """Drop a tenant (columns free once current leases drain)."""
+        with self._lock:
+            ext = self._active.pop(name, None)
+            if ext is None:
+                return
+            ext.state = "retiring"
+            if ext._refs == 0:
+                self._retire(ext)
+            else:
+                self._retiring.append(ext)
+            self._gauges()
+
+    def wait_retired(self, ext: ArenaExtent, timeout: float = 30.0
+                     ) -> bool:
+        return ext._retired_evt.wait(timeout)
+
+    def extent(self, name: str) -> ArenaExtent:
+        with self._lock:
+            ext = self._active.get(name)
+        if ext is None:
+            raise KeyError(f"controller {name!r} is not resident")
+        return ext
+
+    def stats(self) -> dict:
+        with self._lock:
+            with_cols = list(self._active.values()) + self._retiring
+            return {
+                "controllers": len(self._active),
+                "versions": {n: e.version
+                             for n, e in self._active.items()},
+                "resident_cols": sum(e.n_cols for e in with_cols),
+                "resident_bytes": (sum(e.n_cols for e in with_cols)
+                                   * self._col_bytes()),
+                "capacity_cols": self.capacity_cols,
+                "free_cols": sum(s for _, s in self._free),
+                "retiring": len(self._retiring),
+            }
+
+    # -- evaluation --------------------------------------------------------
+
+    def evaluate(self, names, thetas: np.ndarray,
+                 clamp: bool = True, tol: Optional[float] = None,
+                 backend: Optional[str] = None) -> ArenaEvalResult:
+        """One fused launch over a mixed-tenant micro-batch.
+
+        `names`: one controller name per row (a single str broadcasts).
+        Rows are routed by their controller's extent; the launch streams
+        only the pow2-bucketed column window covering the involved
+        extents.  ``clamp=False`` (FallbackPolicy mode 'off') widens the
+        per-row box to +-_BIG so the in-kernel clip is the identity.
+        """
+        thetas = np.asarray(thetas, dtype=np.float64)
+        B, p = thetas.shape
+        if p != self.p:
+            raise ValueError(
+                f"thetas have p={p}, arena holds p={self.p}")
+        if isinstance(names, str):
+            names = [names] * B
+        if len(names) != B:
+            raise ValueError(
+                f"{len(names)} controller names for {B} rows")
+        backend = backend or self.backend
+        with self.lease(names) as exts:
+            if backend == "pallas":
+                lo_col = min(e.start for e in exts.values())
+                hi_col = max(e.end for e in exts.values())
+                lo_tile = lo_col // _TL
+                n_tiles = self.capacity_cols // _TL
+                want = _pow2(_cdiv(hi_col, _TL) - lo_tile)
+                width_tiles = min(want, n_tiles)
+                lo_tile = min(lo_tile, n_tiles - width_tiles)
+                lo_col = lo_tile * _TL
+                width = width_tiles * _TL
+                Bpad = _TB * _cdiv(B, _TB)
+            else:
+                # XLA path evaluates the full buffers (absolute
+                # extents): see _eval_window docstring.
+                lo_col, width = 0, self.capacity_cols
+                Bpad = max(8, _pow2(B))
+            # q packs [th1; lb1; ub1] so the XLA path pays ONE
+            # host->device put for all f32 query planes.
+            q = np.zeros((3, Bpad, self.K), dtype=np.float32)
+            th1, lb1, ub1 = q[0], q[1], q[2]
+            th1[:B, :p] = thetas.astype(np.float32)
+            th1[:B, p] = 1.0
+            ext = np.zeros((Bpad, 2), dtype=np.int32)
+            starts = np.empty(B, dtype=np.int64)
+            for i, n in enumerate(names):
+                e = exts[n]
+                if clamp:
+                    lb1[i, :p] = e.lb.astype(np.float32)
+                    ub1[i, :p] = e.ub.astype(np.float32)
+                else:
+                    lb1[i, :p] = -_BIG
+                    ub1[i, :p] = _BIG
+                lb1[i, p] = 1.0
+                ub1[i, p] = 1.0
+                ext[i, 0] = e.start
+                ext[i, 1] = e.start + e.n_leaves
+                starts[i] = e.start
+            if backend == "pallas":
+                # Mosaic only exists on TPU: a pallas launch anywhere
+                # else (parity tests, per-call overrides) must
+                # interpret.
+                interpret = self.interpret or (
+                    jax.default_backend() != "tpu")
+                val, col, u, cost, clamped = _eval_window(
+                    self.bary, self.U, self.V, jnp.asarray(th1),
+                    jnp.asarray(lb1), jnp.asarray(ub1),
+                    jnp.asarray(ext), np.int32(lo_col), width=width,
+                    interpret=interpret)
+            else:
+                val, col, u, cost, clamped = pallas_eval.arena_eval_xla(
+                    self.baryT, self.U, self.V, jnp.asarray(q),
+                    jnp.asarray(ext))
+            out = (np.asarray(val)[:B], np.asarray(col)[:B],
+                   np.asarray(u)[:B], np.asarray(cost)[:B],
+                   np.asarray(clamped)[:B])
+            versions = {n: e.version for n, e in exts.items()}
+            n_us = {n: e.n_u for n, e in exts.items()}
+        val, col, u, cost, clamped = out
+        tol = self.tol if tol is None else tol
+        served = val >= -tol
+        leaf = col.astype(np.int64) - starts
+        if self._ms:
+            self._ms["launches"].inc()
+        return ArenaEvalResult(u=u, cost=cost, leaf=leaf, col=col,
+                               served=served, clamped=clamped,
+                               versions=versions, n_us=n_us,
+                               width_cols=width)

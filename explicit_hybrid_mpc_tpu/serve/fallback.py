@@ -187,3 +187,58 @@ class FallbackPolicy:
             for i in left:
                 tags[int(i)] = "unserved"
         return EvalResult(u=u, cost=cost, leaf=leaf, inside=inside), tags
+
+    def account_kernel(self, clamped: np.ndarray, served: np.ndarray
+                       ) -> list[Optional[str]]:
+        """Count and tag one FUSED-KERNEL batch (serve/arena.py).
+
+        The fused arena kernel clamps in-kernel and evaluates every row
+        at its box-clamped point, so by the time results reach the host
+        the clamp pass `apply()` would run has already happened.  This
+        method performs exactly the ACCOUNTING `apply()` would, from the
+        kernel's two per-row bits:
+
+        - ``clamped``: the in-kernel clip moved the query (<=> strictly
+          outside the certified box, `apply()`'s ``outside`` test);
+        - ``served``: the clamped point landed inside a leaf
+          (score >= -tol).
+
+        Reconciliation with the host path (the satellite test pins it):
+        a row is ``bad`` iff ``clamped | ~served`` (for un-clamped rows
+        the kernel evaluated the raw point, so ~served == ~inside; for
+        clamped rows the raw point is outside every root simplex, so it
+        was never inside).  cause outside_box = clamped rows, cause
+        hole = ~clamped & ~served; outcome 'clamp' = clamped & served,
+        everything else bad is 'unserved'.  Counter-for-counter this
+        matches `apply()` on the same query mix, away from f32/f64
+        knife edges at box faces and leaf facets.
+
+        mode='off' mirrors `apply()`: rows counted into ``n_seen`` only,
+        no fallback counters, all tags None (the arena then skips the
+        in-kernel clamp entirely, so clamped rows cannot exist).  The
+        kernel path never invokes the configured oracle -- rows an
+        oracle might have rescued are tagged 'unserved' here; route
+        hole-heavy tenants through the host scheduler if oracle rescue
+        matters more than launch fusion.
+        """
+        clamped = np.asarray(clamped, dtype=bool)
+        served = np.asarray(served, dtype=bool)
+        B = clamped.shape[0]
+        self.n_seen += B
+        tags: list[Optional[str]] = [None] * B
+        bad = clamped | ~served
+        if not bad.any() or self.mode == "off":
+            return tags
+        n_out = int(clamped.sum())
+        n_bad = int(bad.sum())
+        self._count("outside_box", n_out)
+        self._count("hole", n_bad - n_out)
+        self._count("total", n_bad)
+        clamp_rows = np.flatnonzero(clamped & served)
+        for i in clamp_rows:
+            tags[int(i)] = "clamp"
+        self._count("clamp", clamp_rows.size)
+        for i in np.flatnonzero(~served):
+            tags[int(i)] = "unserved"
+        self._count("unserved", n_bad - clamp_rows.size)
+        return tags

@@ -394,3 +394,279 @@ class RequestScheduler:
 
     def __exit__(self, *exc) -> None:
         self.close()
+
+
+class _ArenaPending:
+    """One queued submission with its controller route."""
+
+    __slots__ = ("ticket", "name", "thetas", "done")
+
+    def __init__(self, ticket: Ticket, name: str, thetas: np.ndarray):
+        self.ticket = ticket
+        self.name = name
+        self.thetas = thetas
+        self.done = 0
+
+
+class ArenaScheduler:
+    """Mixed-tenant micro-batching front end over a DeviceArena.
+
+    Where RequestScheduler runs one queue + one worker PER controller
+    and pays one device dispatch per controller per flush, this runs
+    ONE queue for all tenants: requests for different controllers pack
+    into the same micro-batch and one fused kernel launch
+    (serve/arena.py) serves them all, each row routed to its own
+    controller's column extent.  At K concurrent tenants the dispatch
+    count drops from K per flush window to 1 -- the
+    ``serve.arena.launches_per_req`` gauge (and the bench-gated
+    ``batch_launches_per_req`` metric) tracks exactly this ratio.
+
+    The fused kernel clamps out-of-box rows to each row's certified box
+    in-device, so the FallbackPolicy's clamp pass is already done by
+    the time results land; ``fallback.account_kernel`` performs the
+    counting/tagging `apply()` would (same ``serve.fallback.*``
+    counters -- tests pin the reconciliation), and per-controller
+    ``serve.ctl.<name>.fallback.outside_box`` counters attribute the
+    clamps.  mode='off' disables the in-kernel clamp (the arena widens
+    the row boxes to the identity) and counts nothing.  The oracle
+    re-solve path does not exist on the kernel path; hole rows come
+    back 'unserved'.
+
+    Every batch leases the involved extents for its full device round
+    trip (arena.evaluate holds them), so a delta-published hot swap
+    mid-traffic follows the same two-epoch handoff as the registry
+    path and results are tagged with the leased version per row.
+    """
+
+    def __init__(self, arena, max_batch: int = 256,
+                 max_wait_us: float = 2000.0, fallback=None,
+                 obs: "obs_lib.Obs | None" = None):
+        if not config_mod.is_pow2(max_batch):
+            raise ValueError(f"max_batch must be a power of two, "
+                             f"got {max_batch}")
+        if max_wait_us <= 0:
+            raise ValueError("max_wait_us must be > 0")
+        self.arena = arena
+        self.max_batch = int(max_batch)
+        self.max_wait_s = float(max_wait_us) * 1e-6
+        self.fallback = fallback
+        self._obs = obs if obs is not None else obs_lib.NOOP
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._queue: deque[_ArenaPending] = deque()
+        self._queued_rows = 0
+        self._closed = False
+        self.n_requests = 0
+        self.n_batches = 0
+        self._lat_roll: deque[float] = deque(maxlen=_ROLL_WINDOW)
+        self._fb_roll: deque[int] = deque(maxlen=_ROLL_WINDOW)
+        self._fill_roll: deque[float] = deque(maxlen=64)
+        self._mix_roll: deque[int] = deque(maxlen=64)
+        self._last_flush = time.perf_counter()
+        self._ms = None
+        self._ctl_ms: dict[str, dict] = {}
+        if self._obs.enabled:
+            m = self._obs.metrics
+            self._ms = {
+                "req_s": m.histogram("serve.arena.request_s"),
+                "depth": m.gauge("serve.arena.queue_depth"),
+                "fill": m.gauge("serve.arena.batch_fill_frac"),
+                "mix": m.gauge("serve.arena.mixed_batch_fill"),
+                "lpr": m.gauge("serve.arena.launches_per_req"),
+                "p99": m.gauge("serve.arena.p99_us"),
+                "fb_frac": m.gauge("serve.arena.fallback_frac"),
+                "requests_all": m.counter("serve.requests"),
+                "batches_all": m.counter("serve.batches"),
+            }
+            from explicit_hybrid_mpc_tpu.obs import clock
+
+            self._obs.event("serve.replica", controller="<arena>",
+                            run_id=clock.run_id(),
+                            host=socket.gethostname(),
+                            pid=os.getpid())
+        self._worker = threading.Thread(
+            target=self._loop, name="serve-arena", daemon=True)
+        self._worker.start()
+
+    def _ctl(self, name: str) -> Optional[dict]:
+        """Lazily minted per-controller counters (worker thread only)."""
+        if not self._obs.enabled:
+            return None
+        ms = self._ctl_ms.get(name)
+        if ms is None:
+            m = self._obs.metrics
+            ns = f"serve.ctl.{name}"
+            ms = {"requests": m.counter(f"{ns}.requests"),
+                  "outside_box": m.counter(f"{ns}.fallback.outside_box")}
+            self._ctl_ms[name] = ms
+        return ms
+
+    # -- submission --------------------------------------------------------
+
+    def submit(self, controller: str, theta: np.ndarray) -> Ticket:
+        """Enqueue ONE query (p,) for `controller`."""
+        return self.submit_batch(controller, np.atleast_2d(theta))
+
+    def submit_batch(self, controller: str, thetas: np.ndarray
+                     ) -> Ticket:
+        """Enqueue a small batch (k, p) for one controller; rows may
+        split across micro-batches (each row still evaluates on exactly
+        one leased version)."""
+        thetas = np.atleast_2d(np.asarray(thetas, dtype=np.float64))
+        if thetas.ndim != 2:
+            raise ValueError(f"thetas must be (k, p), got shape "
+                             f"{thetas.shape}")
+        if thetas.shape[1] != self.arena.p:
+            raise ValueError(
+                f"theta width {thetas.shape[1]} does not match the "
+                f"arena parameter dim {self.arena.p}")
+        self.arena.extent(controller)   # raises KeyError if unpublished
+        t = Ticket(thetas.shape[0])
+        with self._cond:
+            if self._closed:
+                raise RuntimeError("scheduler is closed")
+            self._queue.append(_ArenaPending(t, controller, thetas))
+            self._queued_rows += thetas.shape[0]
+            self.n_requests += thetas.shape[0]
+            if self._ms:
+                with _AGG_LOCK:
+                    self._ms["requests_all"].inc(thetas.shape[0])
+                self._ms["depth"].set(self._queued_rows)
+            self._cond.notify()
+        return t
+
+    def queue_depth(self) -> int:
+        with self._lock:
+            return self._queued_rows
+
+    # -- worker ------------------------------------------------------------
+
+    def _collect(self) -> list[tuple[Ticket, int, str, np.ndarray]]:
+        """Same flush conditions as RequestScheduler._collect, but the
+        claimed rows keep their controller route:
+        [(ticket, row offset in ticket, controller, rows)]."""
+        with self._cond:
+            while True:
+                if self._queue:
+                    oldest = self._queue[0].ticket.t_submit
+                    budget = oldest + self.max_wait_s \
+                        - time.perf_counter()
+                    if self._queued_rows >= self.max_batch \
+                            or budget <= 0 or self._closed:
+                        break
+                    self._cond.wait(timeout=budget)
+                elif self._closed:
+                    return []
+                else:
+                    self._cond.wait()
+            out = []
+            room = self.max_batch
+            while room and self._queue:
+                pend = self._queue[0]
+                take = min(room, pend.thetas.shape[0] - pend.done)
+                out.append((pend.ticket, pend.done, pend.name,
+                            pend.thetas[pend.done:pend.done + take]))
+                pend.done += take
+                room -= take
+                self._queued_rows -= take
+                if pend.done == pend.thetas.shape[0]:
+                    self._queue.popleft()
+            if self._ms:
+                self._ms["depth"].set(self._queued_rows)
+            return out
+
+    def _loop(self) -> None:
+        while True:
+            entries = self._collect()
+            if not entries:
+                return  # closed and drained
+            try:
+                self._serve(entries)
+            except BaseException as e:  # noqa: BLE001 -- scatter, don't die
+                for ticket, _off, _name, _rows in entries:
+                    ticket._fail(e)
+            if self._ms:
+                now = time.perf_counter()
+                if now - self._last_flush >= METRICS_FLUSH_S:
+                    self._last_flush = now
+                    self._obs.flush_metrics()
+
+    def _serve(self, entries) -> None:
+        thetas = np.concatenate([rows for _t, _o, _n, rows in entries])
+        names: list[str] = []
+        for _t, _o, name, rows in entries:
+            names.extend([name] * rows.shape[0])
+        B = thetas.shape[0]
+        fill = B / min(sharded_mod._bucket(B), self.max_batch)
+        self._fill_roll.append(fill)
+        self._mix_roll.append(len(set(names)))
+        self.n_batches += 1
+        faults_inj.fire("serve.batch", label="<arena>")
+        mode_off = (self.fallback is not None
+                    and self.fallback.mode == "off")
+        # ONE launch for the whole mixed-tenant batch; arena.evaluate
+        # leases every involved extent across the device round trip.
+        res = self.arena.evaluate(names, thetas, clamp=not mode_off)
+        if self.fallback is not None:
+            tags = self.fallback.account_kernel(res.clamped, res.served)
+        else:
+            tags = [None] * B
+        now = time.perf_counter()
+        if self._ms:
+            with _AGG_LOCK:
+                self._ms["batches_all"].inc()
+            self._ms["fill"].set(
+                sum(self._fill_roll) / len(self._fill_roll))
+            self._ms["mix"].set(
+                sum(self._mix_roll) / len(self._mix_roll))
+            if self.n_requests:
+                self._ms["lpr"].set(self.n_batches / self.n_requests)
+        lo = 0
+        for ticket, off, name, rows in entries:
+            k = rows.shape[0]
+            lat = now - ticket.t_submit
+            n_u = res.n_us[name]
+            version = res.versions[name]
+            results = [
+                ServeResult(u=np.array(res.u[lo + i, :n_u],
+                                       dtype=np.float64),
+                            cost=float(res.cost[lo + i]),
+                            leaf=int(res.leaf[lo + i]),
+                            inside=bool(res.served[lo + i]),
+                            version=version,
+                            fallback=tags[lo + i],
+                            latency_s=lat)
+                for i in range(k)]
+            cms = self._ctl(name)
+            if cms:
+                cms["requests"].inc(k)
+                n_out = int(np.sum(res.clamped[lo:lo + k]))
+                if n_out:
+                    cms["outside_box"].inc(n_out)
+            self._lat_roll.extend([lat] * k)
+            self._fb_roll.extend(
+                [0 if t is None else 1 for t in tags[lo:lo + k]])
+            if self._ms:
+                self._ms["req_s"].observe(lat, n=k)
+            ticket._fill(off, results)
+            lo += k
+        if self._ms and self._lat_roll:
+            lat_us = np.asarray(self._lat_roll) * 1e6
+            self._ms["p99"].set(float(np.percentile(lat_us, 99)))
+            self._ms["fb_frac"].set(
+                sum(self._fb_roll) / len(self._fb_roll))
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def close(self, timeout: Optional[float] = 30.0) -> None:
+        """Stop accepting, drain everything queued, join the worker."""
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+        self._worker.join(timeout)
+
+    def __enter__(self) -> "ArenaScheduler":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -138,6 +138,20 @@ GATED_METRICS: dict[str, tuple] = {
     # against 2-core wall noise.  Multichip rows carry no "value", so
     # the trailing windows never mix metric families.
     "multichip_scaling_frac": ("higher", 0.20, 0.10),
+    # Device-resident multi-tenant arena (scripts/serve_bench.py
+    # SERVE_BENCH_TENANTS mode; serve/arena.py): publish_delta wall for
+    # an O(changed) hot swap, and fused launches per served request at
+    # the top offered rate.  Swap wall is dominated by the device-side
+    # copy-on-write of the shared payload buffers (the snapshot-
+    # isolation price that keeps in-flight launches torn-free,
+    # docs/serving.md#device-resident-arena) and rides a 1-core
+    # contended CI host, so it gets a wide band + absolute slack.
+    # Launch amortization is the tentpole figure -- 1/K-ish at healthy
+    # mixed-batch fill -- and near-deterministic, so it gates tight
+    # with a small absolute slack.  Arena rows carry no "value", so
+    # the trailing windows never mix metric families.
+    "arena_swap_us": ("lower", 0.50, 20000.0),
+    "batch_launches_per_req": ("lower", 0.25, 0.05),
 }
 
 _ROW_EXTRAS = ("regions", "unit", "precision", "truncated",
@@ -173,7 +187,16 @@ _ROW_EXTRAS = ("regions", "unit", "precision", "truncated",
                # staleness_p50_s rides next to the gated p99.
                "drift_generations", "reuse_fracs", "reuse_decay",
                "excl_events_trajectory", "staleness_p50_s",
-               "sla_misses", "revisions_superseded")
+               "sla_misses", "revisions_superseded",
+               # Multi-tenant arena rows (serve_bench.py
+               # SERVE_BENCH_TENANTS mode): tenant count + residency +
+               # mixed-batch composition join the gated arena metrics
+               # back to their capture; delta_n_fresh/_n_kept are the
+               # O(changed) split of the measured hot swap
+               # (informational, not gated -- they are artifact-shaped,
+               # not monotone).
+               "tenants", "arena_controllers", "arena_resident_bytes",
+               "mixed_batch_fill", "delta_n_fresh", "delta_n_kept")
 
 
 def summarize(bench: dict, source: str, mtime: float | None = None) -> dict:

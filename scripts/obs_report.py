@@ -233,6 +233,23 @@ def report(records: list[dict]) -> dict:
                     "serve.route_brute_queries", 0),
                 "query_s": out["histograms"].get("serve.query_s"),
             }
+        # Device-resident multi-tenant arena (serve/arena.py +
+        # ArenaScheduler): residency, hot-swap latency, and launch
+        # amortization for the fused mixed-tenant serving path
+        # (docs/serving.md#device-resident-arena).
+        ar = {}
+        for gname in ("controllers", "resident_bytes", "free_cols",
+                      "launches_per_req", "mixed_batch_fill",
+                      "batch_fill_frac", "p99_us", "fallback_frac"):
+            if f"serve.arena.{gname}" in out["gauges"]:
+                ar[gname] = out["gauges"][f"serve.arena.{gname}"]
+        for cname in ("publishes", "delta_publishes", "launches"):
+            if f"serve.arena.{cname}" in out["counters"]:
+                ar[cname] = out["counters"][f"serve.arena.{cname}"]
+        if "serve.arena.swap_us" in out["histograms"]:
+            ar["swap_us"] = out["histograms"]["serve.arena.swap_us"]
+        if ar:
+            out["arena"] = ar
 
     # -- warnings: degraded-capture signals recorded in the stream ---------
     # (host.* gauges since PR 2, surfaced here since ISSUE 4 -- a report
@@ -394,6 +411,24 @@ def diff_bench(rep: dict, bench: dict, tol: float = 0.10) -> list[str]:
         flags.append(
             f"speculation waste regression: {r_waste:.3f} vs bench "
             f"{b_waste:.3f}")
+    # Multi-tenant arena regressions (ISSUE 16), directional like the
+    # rest: a slower delta hot swap holds the two-epoch window (and
+    # its double residency) open longer; more launches per request
+    # means mixed-tenant batching stopped amortizing dispatch, which
+    # is the tentpole figure of the arena path.
+    ar = rep.get("arena", {})
+    b_swap = bench.get("arena_swap_us")
+    r_swap = (ar.get("swap_us") or {}).get("p99")
+    if b_swap and r_swap is not None and r_swap > (1 + tol) * b_swap:
+        flags.append(
+            f"arena swap regression: p99 {r_swap:.0f}us vs bench "
+            f"{b_swap:.0f}us ({100 * (r_swap / b_swap - 1):.0f}% slower)")
+    b_lpr = bench.get("batch_launches_per_req")
+    r_lpr = ar.get("launches_per_req")
+    if b_lpr and r_lpr is not None and r_lpr > (1 + tol) * b_lpr:
+        flags.append(
+            f"arena launch-amortization regression: {r_lpr:.3f} "
+            f"launches/req vs bench {b_lpr:.3f}")
     # Serving headline: sharded us/query against the bench's large-L
     # figure, when both sides measured it.
     b_us = bench.get("large_l_sharded_us_per_query")
@@ -530,6 +565,20 @@ def render_text(rep: dict, flags: list[str], bench_path: str | None) -> str:
             row = srv["shards"][sid]
             ln.append(f"  shard {sid}: {row['count']} queries, p50 "
                       f"{_fmt_lat(row['p50'])}, p99 {_fmt_lat(row['p99'])}")
+    ar = rep.get("arena")
+    if ar:
+        ln.append(
+            f"arena: {int(ar.get('controllers', 0))} controller(s) "
+            f"resident ({(ar.get('resident_bytes') or 0) / 2**20:.1f} "
+            f"MiB), {int(ar.get('launches', 0))} fused launch(es), "
+            f"launches/req {(ar.get('launches_per_req') or 0):.3f}, "
+            f"mixed fill {(ar.get('mixed_batch_fill') or 0):.2f}")
+        sw = ar.get("swap_us")
+        if sw:
+            ln.append(
+                f"arena swap: {int(sw['count'])} publish(es), p50 "
+                f"{_fmt_lat(sw['p50'] / 1e6)}, p99 "
+                f"{_fmt_lat(sw['p99'] / 1e6)}")
     if bench_path:
         ln.append(f"bench diff vs {os.path.basename(bench_path)}: "
                   + ("OK" if not flags else f"{len(flags)} flag(s)"))

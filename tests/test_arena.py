@@ -1,0 +1,412 @@
+"""Device-resident multi-tenant arena (serve/arena.py) + the mixed-
+tenant ArenaScheduler (serve/scheduler.py): directory lifecycle,
+two-epoch hot swap, O(changed) delta publish (bitwise vs a full
+re-pack), launch fusion, and kernel-path vs host-path fallback-counter
+reconciliation."""
+
+import os
+import threading
+
+import numpy as np
+import pytest
+
+import explicit_hybrid_mpc_tpu  # noqa: F401  (enables x64)
+import jax.numpy as jnp
+from explicit_hybrid_mpc_tpu import obs as obs_lib
+from explicit_hybrid_mpc_tpu.online import evaluator, export
+from explicit_hybrid_mpc_tpu.serve import (ArenaFull, ArenaScheduler,
+                                           DeviceArena, FallbackPolicy)
+
+
+def _synthetic_table(rng, L=40, p=2, n_u=2):
+    """Disjoint unit-grid simplices (test_pallas_fused idiom)."""
+    from explicit_hybrid_mpc_tpu.partition import geometry
+
+    base = np.vstack([np.zeros(p), np.eye(p)])
+    side = int(np.ceil(np.sqrt(L)))
+    bary, U, V = [], [], []
+    for i in range(L):
+        off = np.array([i % side, i // side], dtype=float)[:p]
+        verts = 0.8 * base + off + 0.1 * rng.uniform(size=p)
+        bary.append(geometry.barycentric_matrix(verts))
+        U.append(rng.normal(size=(p + 1, n_u)))
+        V.append(np.abs(rng.normal(size=p + 1)))
+    return export.LeafTable(
+        bary_M=np.stack(bary), U=np.stack(U), V=np.stack(V),
+        delta=np.zeros(L, dtype=np.int64),
+        node_id=np.arange(L, dtype=np.int64))
+
+
+def _centroids(table):
+    return np.stack([np.linalg.inv(table.bary_M[i])[:-1, :].mean(axis=1)
+                     for i in range(table.n_leaves)])
+
+
+_BOX = (np.zeros(2), np.full(2, 8.0))
+
+
+# -- directory / allocation -----------------------------------------------
+
+
+def test_publish_stats_and_capacity(rng):
+    arena = DeviceArena(p=2, n_u=2, capacity_cols=256, backend="xla")
+    t = _synthetic_table(rng)
+    arena.publish("a", "v1", t, *_BOX)
+    arena.publish("b", "v1", _synthetic_table(rng, L=30), *_BOX)
+    s = arena.stats()
+    assert s["controllers"] == 2 and s["free_cols"] == 0
+    assert s["versions"] == {"a": "v1", "b": "v1"}
+    assert s["resident_bytes"] == 256 * arena._col_bytes()
+    with pytest.raises(ArenaFull):
+        arena.publish("c", "v1", _synthetic_table(rng, L=5), *_BOX)
+    # Republishing the SAME (name, version) is a publisher bug, not a
+    # swap: the directory must reject it rather than double-allocate.
+    with pytest.raises(ValueError):
+        arena.publish("a", "v1", t, *_BOX)
+    # Retiring a tenant frees its columns for the next publish.
+    arena.retire("a")
+    assert arena.stats()["free_cols"] == 128
+    arena.publish("c", "v1", _synthetic_table(rng, L=5), *_BOX)
+    with pytest.raises(KeyError):
+        arena.extent("a")
+    with pytest.raises(KeyError):
+        arena.evaluate("a", np.zeros((1, 2)))
+
+
+def test_capacity_must_be_tile_multiple():
+    with pytest.raises(ValueError):
+        DeviceArena(p=2, n_u=2, capacity_cols=100)
+    with pytest.raises(ValueError):
+        DeviceArena(p=2, n_u=2, capacity_cols=0)
+
+
+def test_theta_width_mismatch(rng):
+    arena = DeviceArena(p=2, n_u=2, capacity_cols=128, backend="xla")
+    arena.publish("a", "v1", _synthetic_table(rng), *_BOX)
+    with pytest.raises(ValueError):
+        arena.evaluate("a", np.zeros((2, 3)))
+    with pytest.raises(ValueError):
+        arena.evaluate(["a", "a", "a"], np.zeros((2, 2)))
+
+
+# -- two-epoch hot swap ---------------------------------------------------
+
+
+def test_two_epoch_handoff(rng):
+    arena = DeviceArena(p=2, n_u=2, capacity_cols=256, backend="xla")
+    t1, t2 = _synthetic_table(rng), _synthetic_table(rng)
+    e1 = arena.publish("a", "v1", t1, *_BOX)
+    with arena.lease(["a"]):
+        arena.publish("a", "v2", t2, *_BOX)
+        # The directory flips immediately; the leased old extent only
+        # RETIRES -- its columns must not be reused under the reader.
+        assert arena.extent("a").version == "v2"
+        assert e1.state == "retiring"
+        assert not arena.wait_retired(e1, timeout=0.05)
+    assert e1.state == "retired"
+    assert arena.wait_retired(e1, timeout=1.0)
+    assert arena.stats()["retiring"] == 0
+    # New queries land on v2's payloads.
+    out = arena.evaluate("a", _centroids(t2)[:4])
+    ref = evaluator.evaluate(evaluator.stage(t2),
+                             jnp.asarray(_centroids(t2)[:4]))
+    assert np.array_equal(out.leaf, np.asarray(ref.leaf))
+    assert out.versions == {"a": "v2"}
+
+
+def test_swap_without_reader_retires_immediately(rng):
+    arena = DeviceArena(p=2, n_u=2, capacity_cols=256, backend="xla")
+    e1 = arena.publish("a", "v1", _synthetic_table(rng), *_BOX)
+    arena.publish("a", "v2", _synthetic_table(rng), *_BOX)
+    assert e1.state == "retired" and arena.wait_retired(e1, 0.0)
+    assert arena.stats()["free_cols"] == 128
+
+
+# -- delta publish --------------------------------------------------------
+
+
+def test_publish_delta_bitwise_and_o_changed(rng, tmp_path):
+    from explicit_hybrid_mpc_tpu.lifecycle.delta import (
+        DeltaMismatch, apply_delta, write_delta_artifact)
+    from explicit_hybrid_mpc_tpu.partition.synthetic import \
+        build_synthetic_tree
+    from explicit_hybrid_mpc_tpu.serve.registry import save_artifacts
+
+    base_dir = str(tmp_path / "base")
+    delta_dir = str(tmp_path / "delta")
+    out_dir = str(tmp_path / "v2_full")
+    tree1, roots1 = build_synthetic_tree(p=2, depth=6, n_u=2)
+    # An unstamped base cannot anchor a delta (provenance gate), so
+    # stamp the synthetic artifact explicitly.
+    save_artifacts(tree1, roots1, base_dir,
+                   provenance={"problem": "synthetic"})
+    tree2, roots2 = build_synthetic_tree(p=2, depth=6, n_u=2)
+    # Double HALF the (used) payload slots: exact in floating point,
+    # and the delta stays O(changed) -- the untouched half must ride
+    # as kept rows.  (_pl_inputs is a preallocated pool; only the
+    # first _n_slots rows are live.)
+    n_pl = tree2._n_slots
+    tree2._pl_inputs[:n_pl // 2] *= 2.0
+    tree2._pl_costs[:n_pl // 2] *= 2.0
+    stats = write_delta_artifact(tree2, roots2, delta_dir, base_dir,
+                                 base_version="v1")
+    assert 0 < stats["n_fresh"] < stats["n_fresh"] + stats["n_kept"]
+    assert stats["n_kept"] > 0
+
+    arena = DeviceArena(p=2, n_u=2, capacity_cols=512, backend="xla")
+    e1 = arena.publish_from_artifacts("c", "v1", base_dir)
+    e2 = arena.publish_delta("c", "v2", delta_dir, base_dir)
+    assert arena.extent("c").version == "v2"
+    assert e2.n_leaves == e1.n_leaves
+
+    # Bitwise contract: the delta-applied extent equals a FULL re-pack
+    # of the reconstructed v2 table, column for column.
+    apply_delta(delta_dir, base_dir, out_dir)
+    ref = DeviceArena(p=2, n_u=2, capacity_cols=512, backend="xla")
+    e_ref = ref.publish_from_artifacts("c", "v2", out_dir)
+    sl = np.s_[e2.start:e2.end]
+    rl = np.s_[e_ref.start:e_ref.end]
+    assert np.array_equal(np.asarray(arena.bary[:, :, sl]),
+                          np.asarray(ref.bary[:, :, rl]))
+    assert np.array_equal(np.asarray(arena.U[:, sl, :]),
+                          np.asarray(ref.U[:, rl, :]))
+    assert np.array_equal(np.asarray(arena.V[:, sl]),
+                          np.asarray(ref.V[:, rl]))
+
+    # Wrong resident generation => DeltaMismatch, directory untouched.
+    with pytest.raises(DeltaMismatch):
+        arena.publish_delta("c", "v3", delta_dir, base_dir)
+    with pytest.raises(DeltaMismatch):
+        arena.publish_delta("nope", "v2", delta_dir, base_dir)
+    assert arena.extent("c").version == "v2"
+
+
+# -- mixed-tenant scheduler -----------------------------------------------
+
+
+def test_arena_scheduler_mixed_batches(rng):
+    o = obs_lib.Obs("jsonl")
+    arena = DeviceArena(p=2, n_u=2, capacity_cols=512, backend="xla",
+                        obs=o)
+    tables = {}
+    for k in range(3):
+        tables[f"t{k}"] = _synthetic_table(rng, L=20 + 3 * k)
+        arena.publish(f"t{k}", "v1", tables[f"t{k}"], *_BOX)
+    fb = FallbackPolicy(*_BOX, obs=o)
+    n_req = 36
+    with ArenaScheduler(arena, max_batch=64, max_wait_us=20000.0,
+                        fallback=fb, obs=o) as sched:
+        names = [f"t{i % 3}" for i in range(n_req)]
+        thetas = [_centroids(tables[nm])[i % 10] for i, nm
+                  in enumerate(names)]
+        tickets = [sched.submit(nm, th) for nm, th
+                   in zip(names, thetas)]
+        results = [t.result(30.0)[0] for t in tickets]
+        # Launch fusion: 36 single-row submissions across 3 tenants in
+        # a 20 ms wait window must coalesce -- strictly fewer launches
+        # than requests (the tentpole's dispatch-count win).
+        assert sched.n_requests == n_req
+        assert sched.n_batches < n_req
+        for nm, th, r in zip(names, thetas, results):
+            ref = evaluator.evaluate(evaluator.stage(tables[nm]),
+                                     jnp.asarray(th[None, :]))
+            assert r.leaf == int(np.asarray(ref.leaf)[0])
+            assert r.inside and r.version == "v1"
+            assert r.fallback is None
+            np.testing.assert_allclose(r.u, np.asarray(ref.u)[0],
+                                       atol=1e-5)
+        snap = o.metrics.snapshot()["counters"]
+        assert snap.get("serve.requests") == n_req
+        assert snap.get("serve.batches") == sched.n_batches
+        assert sum(snap.get(f"serve.ctl.t{k}.requests", 0)
+                   for k in range(3)) == n_req
+        assert snap.get("serve.arena.launches", 0) == sched.n_batches
+        with pytest.raises(KeyError):
+            sched.submit("ghost", np.zeros(2))
+        with pytest.raises(ValueError):
+            sched.submit("t0", np.zeros(3))
+    with pytest.raises(RuntimeError):
+        sched.submit("t0", np.zeros(2))
+    o.close()
+
+
+def test_arena_scheduler_pow2_validation(rng):
+    arena = DeviceArena(p=2, n_u=2, capacity_cols=128, backend="xla")
+    arena.publish("a", "v1", _synthetic_table(rng), *_BOX)
+    with pytest.raises(ValueError):
+        ArenaScheduler(arena, max_batch=48)
+    with pytest.raises(ValueError):
+        ArenaScheduler(arena, max_wait_us=0.0)
+
+
+def test_scheduler_swap_during_traffic(rng):
+    """Requests racing a hot swap: nothing drops, every row is tagged
+    with the version it actually evaluated on, and the old extent
+    drains (two-epoch under real traffic)."""
+    arena = DeviceArena(p=2, n_u=2, capacity_cols=256, backend="xla")
+    t1, t2 = _synthetic_table(rng), _synthetic_table(rng)
+    e1 = arena.publish("a", "v1", t1, *_BOX)
+    cents = _centroids(t1)
+    with ArenaScheduler(arena, max_batch=8, max_wait_us=500.0) as sched:
+        tickets, stop = [], threading.Event()
+
+        def pump():
+            for i in range(200):
+                tickets.append(sched.submit("a", cents[i % 40]))
+            stop.set()
+
+        th = threading.Thread(target=pump)
+        th.start()
+        arena.publish("a", "v2", t2, *_BOX)
+        th.join()
+        results = [t.result(30.0)[0] for t in tickets]
+    versions = {r.version for r in results}
+    assert versions <= {"v1", "v2"} and "v2" in versions
+    assert all(r.inside for r in results)
+    assert arena.wait_retired(e1, timeout=10.0)
+
+
+# -- fallback reconciliation ----------------------------------------------
+
+
+class _HostServer:
+    """Minimal host-path server shim for FallbackPolicy.apply: the f64
+    evaluator with no root_bary (the policy then clamps to its
+    constructor box, same box the arena rows carry)."""
+
+    root_bary = None
+
+    def __init__(self, table):
+        self._dev = evaluator.stage(table)
+
+    def evaluate(self, thetas):
+        return evaluator.evaluate(self._dev, jnp.asarray(thetas))
+
+
+def test_fallback_counters_reconcile_kernel_vs_host(rng):
+    """THE satellite contract: on the same query mix, the kernel path
+    (arena clamp + account_kernel) and the host path (f64 evaluate +
+    FallbackPolicy.apply) must land identical serve.fallback.* counter
+    values and identical per-row tags."""
+    table = _synthetic_table(rng, L=40)
+    cents = _centroids(table)
+    # Box whose upper corner IS a cell centroid: far-out queries clamp
+    # exactly onto a covered point, so the clamp outcome is decided
+    # identically by both paths (no knife-edge geometry).
+    lb = np.zeros(2)
+    ub = cents[np.argmax(cents.sum(axis=1))]
+    thetas = np.concatenate([
+        cents[:6],                          # served in place
+        np.array([[0.95, 0.95],             # in-box uncovered: holes
+                  [1.95, 2.95]]),
+        ub + np.array([[2.0, 3.0],          # outside -> clamp to ub
+                       [5.0, 0.5]]),        #   (a covered centroid)
+        np.array([[-1.0, 0.95]]),           # outside -> clamp lands in
+    ])                                      #   an uncovered gap
+    thetas[-1] = np.array([-1.0, 0.95])
+
+    o_k = obs_lib.Obs("jsonl")
+    arena = DeviceArena(p=2, n_u=2, capacity_cols=128, backend="xla",
+                        obs=o_k)
+    arena.publish("a", "v1", table, lb, ub)
+    fb_k = FallbackPolicy(lb, ub, obs=o_k)
+    res = arena.evaluate("a", thetas)
+    tags_k = fb_k.account_kernel(res.clamped, res.served)
+
+    o_h = obs_lib.Obs("jsonl")
+    fb_h = FallbackPolicy(lb, ub, obs=o_h)
+    server = _HostServer(table)
+    raw = server.evaluate(thetas)
+    patched, tags_h = fb_h.apply(thetas, raw, server)
+
+    assert tags_k == tags_h
+    assert fb_k.n_seen == fb_h.n_seen == thetas.shape[0]
+    ck = o_k.metrics.snapshot()["counters"]
+    ch = o_h.metrics.snapshot()["counters"]
+    for key in ("outside_box", "hole", "clamp", "unserved",
+                "requests"):
+        assert ck.get(f"serve.fallback.{key}", 0) == \
+            ch.get(f"serve.fallback.{key}", 0), key
+    # And the mix genuinely exercised every class.
+    assert ck["serve.fallback.outside_box"] == 3
+    assert ck["serve.fallback.hole"] == 2
+    assert ck["serve.fallback.clamp"] == 2
+    assert ck["serve.fallback.unserved"] == 3
+    # Served clamped rows carry the clamped point's law on both paths.
+    clamp_rows = [i for i, t in enumerate(tags_k) if t == "clamp"]
+    np.testing.assert_allclose(
+        res.u[clamp_rows, :2], np.asarray(patched.u)[clamp_rows],
+        atol=1e-5)
+    o_k.close()
+    o_h.close()
+
+
+def test_fallback_mode_off_counts_nothing(rng):
+    o = obs_lib.Obs("jsonl")
+    fb = FallbackPolicy(*_BOX, mode="off", obs=o)
+    tags = fb.account_kernel(np.array([True, False]),
+                             np.array([False, True]))
+    assert tags == [None, None] and fb.n_seen == 2
+    snap = o.metrics.snapshot()["counters"]
+    assert snap.get("serve.fallback.requests", 0) == 0
+    o.close()
+
+
+# -- obs_report integration -----------------------------------------------
+
+
+def _load_script(name):
+    import importlib.util
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(repo, "scripts", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_obs_report_renders_arena_block(rng, tmp_path):
+    """The arena's obs stream assembles into rep['arena'], renders the
+    `arena:` / `arena swap:` lines, and both new bench metrics
+    diff-flag directionally."""
+    path = str(tmp_path / "arena.obs.jsonl")
+    o = obs_lib.Obs("jsonl", path=path)
+    arena = DeviceArena(p=2, n_u=2, capacity_cols=384, backend="xla",
+                        obs=o)
+    tables = {n: _synthetic_table(rng, L=20) for n in ("a", "b")}
+    for n, t in tables.items():
+        arena.publish(n, "v1", t, *_BOX)
+    with ArenaScheduler(arena, max_batch=8, max_wait_us=20000.0,
+                        obs=o) as sched:
+        tickets = [sched.submit(n, _centroids(tables[n])[i % 5])
+                   for i, n in enumerate(["a", "b"] * 8)]
+        for t in tickets:
+            t.result(30.0)
+    arena.publish("a", "v2", tables["a"], *_BOX)  # third swap_us sample
+    o.flush_metrics()
+    o.close()
+    from explicit_hybrid_mpc_tpu.obs.sink import load_jsonl
+
+    obs_report = _load_script("obs_report")
+    rep = obs_report.report(load_jsonl(path))
+    ar = rep["arena"]
+    assert ar["controllers"] == 2
+    assert ar["publishes"] == 3
+    assert ar["launches"] >= 1
+    assert ar["swap_us"]["count"] == 3
+    assert ar["resident_bytes"] > 0
+    assert ar["launches_per_req"] <= 1.0
+    txt = obs_report.render_text(rep, [], None)
+    assert "arena:" in txt and "arena swap:" in txt
+    # Directional regression flags vs a (better) bench row.
+    flags = obs_report.diff_bench(
+        rep, {"arena_swap_us": ar["swap_us"]["p99"] / 10,
+              "batch_launches_per_req": 1e-4})
+    assert any("arena swap regression" in f for f in flags)
+    assert any("launch-amortization" in f for f in flags)
+    # And a bench row this run BEATS raises no arena flags.
+    flags_ok = obs_report.diff_bench(
+        rep, {"arena_swap_us": ar["swap_us"]["p99"] * 10,
+              "batch_launches_per_req": 2.0})
+    assert not any("arena" in f for f in flags_ok)

@@ -1,0 +1,222 @@
+"""Fused arena kernel (descent -> barycentric eval -> certified-box
+clamp in ONE pallas_call, online/pallas_eval.arena_eval_fused) vs its
+references, in interpret mode on CPU (on TPU the same kernel compiles
+via Mosaic).
+
+Parity contract (docs/serving.md "Device-resident arena"):
+
+- vs the f64 host evaluator (online/evaluator.py): EXACT leaf ids on
+  well-separated queries (disjoint cells queried at their centroids)
+  and u/cost to f32 tolerance.  Point location runs the argmax in f32
+  on the kernel path, so knife-edge queries equidistant between two
+  leaves may legitimately tie-break differently from the f64
+  reference -- the suite queries centroids precisely to stay off that
+  edge (same caveat as test_pallas_eval.py).
+- vs the plain-XLA twin (arena_eval_xla) over the SAME buffers: exact
+  leaf/served/clamped agreement, values to 1e-5.  Values are NOT
+  asserted bitwise ACROSS backends (different f32 reduction order);
+  each backend is deterministic WITHIN itself, which is what the
+  serve_bench torn-read audit relies on.
+- clamp semantics: a clamped row is bitwise the same backend's
+  evaluation of the pre-clipped query.
+"""
+
+import numpy as np
+import pytest
+
+import explicit_hybrid_mpc_tpu  # noqa: F401  (enables x64)
+import jax.numpy as jnp
+from explicit_hybrid_mpc_tpu.online import evaluator, export, pallas_eval
+from explicit_hybrid_mpc_tpu.serve.arena import DeviceArena
+
+
+def _synthetic_table(rng, L=40, p=2, n_u=2):
+    """Disjoint unit-grid simplices (same construction as
+    test_pallas_eval._synthetic_table, replicated so the suites stay
+    independently runnable): each simplex uniquely contains its own
+    centroid, so location is exact and f32 must agree with f64 on
+    ids."""
+    from explicit_hybrid_mpc_tpu.partition import geometry
+
+    base = np.vstack([np.zeros(p), np.eye(p)])
+    side = int(np.ceil(np.sqrt(L)))
+    bary, U, V = [], [], []
+    for i in range(L):
+        off = np.array([i % side, i // side], dtype=float)[:p]
+        verts = 0.8 * base + off + 0.1 * rng.uniform(size=p)
+        bary.append(geometry.barycentric_matrix(verts))
+        U.append(rng.normal(size=(p + 1, n_u)))
+        V.append(np.abs(rng.normal(size=p + 1)))
+    return export.LeafTable(
+        bary_M=np.stack(bary), U=np.stack(U), V=np.stack(V),
+        delta=np.zeros(L, dtype=np.int64),
+        node_id=np.arange(L, dtype=np.int64))
+
+
+def _centroids(table):
+    return np.stack([np.linalg.inv(table.bary_M[i])[:-1, :].mean(axis=1)
+                     for i in range(table.n_leaves)])
+
+
+_BOX = (np.zeros(2), np.full(2, 8.0))  # covers the 7x7 grid + margin
+
+
+@pytest.fixture(scope="module")
+def arena_pair():
+    """One arena, two tenants at distinct extents, + f64 references."""
+    rng = np.random.default_rng(77)
+    ta = _synthetic_table(rng, L=40)
+    tb = _synthetic_table(rng, L=37)
+    arena = DeviceArena(p=2, n_u=2, capacity_cols=256, backend="xla")
+    arena.publish("a", "v1", ta, *_BOX)
+    arena.publish("b", "v1", tb, *_BOX)
+    return arena, {"a": ta, "b": tb}
+
+
+def test_fused_single_controller_vs_f64_evaluator(arena_pair):
+    """Interpret-mode fused kernel vs the f64 host evaluator on one
+    tenant's centroids: exact leaf ids, all served, nothing clamped,
+    u/cost to f32 tolerance."""
+    arena, tables = arena_pair
+    ta = tables["a"]
+    cents = _centroids(ta)
+    ref = evaluator.evaluate(evaluator.stage(ta), jnp.asarray(cents))
+    out = arena.evaluate("a", cents, backend="pallas")
+    assert np.array_equal(out.leaf, np.asarray(ref.leaf))
+    assert bool(np.all(out.served))
+    assert not bool(np.any(out.clamped))
+    np.testing.assert_allclose(out.u[:, :2], np.asarray(ref.u),
+                               atol=1e-5)
+    np.testing.assert_allclose(out.cost, np.asarray(ref.cost),
+                               rtol=1e-5, atol=1e-5)
+    assert np.all(out.u[:, 2:] == 0.0)  # padded lanes stay exact zeros
+
+
+def test_fused_mixed_tenant_parity(arena_pair):
+    """Interleaved rows routed to different extents in ONE launch must
+    each match their own controller's f64 reference -- the launch-fusion
+    tentpole is only a win if routing is exact."""
+    arena, tables = arena_pair
+    ca, cb = _centroids(tables["a"]), _centroids(tables["b"])
+    n = min(len(ca), len(cb))
+    names, rows = [], []
+    for i in range(n):  # a, b, a, b, ... interleaved
+        names += ["a", "b"]
+        rows += [ca[i], cb[i]]
+    thetas = np.stack(rows)
+    for backend in ("xla", "pallas"):
+        out = arena.evaluate(names, thetas, backend=backend)
+        for key, tab, cents in (("a", tables["a"], ca),
+                                ("b", tables["b"], cb)):
+            sel = np.asarray([nm == key for nm in names])
+            ref = evaluator.evaluate(evaluator.stage(tab),
+                                     jnp.asarray(thetas[sel]))
+            assert np.array_equal(out.leaf[sel], np.asarray(ref.leaf)), \
+                (backend, key)
+            np.testing.assert_allclose(out.u[sel, :2],
+                                       np.asarray(ref.u), atol=1e-5)
+            np.testing.assert_allclose(out.cost[sel],
+                                       np.asarray(ref.cost),
+                                       rtol=1e-5, atol=1e-5)
+        assert bool(np.all(out.served)), backend
+        assert out.versions == {"a": "v1", "b": "v1"}
+
+
+def test_fused_vs_xla_same_buffers(arena_pair):
+    """The pallas and XLA backends read the SAME resident buffers and
+    must agree exactly on every discrete output (leaf, served, clamped)
+    and to 1e-5 on values.  Bitwise value equality is only guaranteed
+    WITHIN a backend (module docstring)."""
+    arena, tables = arena_pair
+    rng = np.random.default_rng(3)
+    thetas = rng.uniform(0.0, 7.0, size=(24, 2))
+    names = ["a" if i % 3 else "b" for i in range(24)]
+    xla = arena.evaluate(names, thetas, backend="xla")
+    pal = arena.evaluate(names, thetas, backend="pallas")
+    assert np.array_equal(xla.leaf, pal.leaf)
+    assert np.array_equal(xla.served, pal.served)
+    assert np.array_equal(xla.clamped, pal.clamped)
+    np.testing.assert_allclose(xla.u, pal.u, atol=1e-5)
+    np.testing.assert_allclose(xla.cost, pal.cost, rtol=1e-5,
+                               atol=1e-5)
+
+
+@pytest.mark.parametrize("backend", ["xla", "pallas"])
+def test_fused_clamp_is_clipped_eval(arena_pair, backend):
+    """Out-of-box rows: the kernel must flag them clamped AND return
+    bitwise the same backend's evaluation of the pre-clipped query --
+    the in-kernel clip is semantically clip-then-evaluate, fused."""
+    arena, tables = arena_pair
+    lb, ub = _BOX
+    rng = np.random.default_rng(5)
+    inside = rng.uniform(1.0, 6.0, size=(4, 2))
+    outside = np.stack([ub + np.array([1.0, 2.5]),
+                        lb - np.array([0.5, 3.0]),
+                        np.array([-1.0, 4.0]),
+                        np.array([3.0, 9.5])])
+    thetas = np.concatenate([inside, outside])
+    names = ["a"] * 8
+    out = arena.evaluate(names, thetas, backend=backend)
+    assert not np.any(out.clamped[:4])
+    assert np.all(out.clamped[4:])
+    ref = arena.evaluate(names, np.clip(thetas, lb, ub),
+                         backend=backend)
+    assert not np.any(ref.clamped)
+    # Bitwise: same backend, same buffers, same effective query.
+    assert np.array_equal(out.u, ref.u)
+    assert np.array_equal(out.cost, ref.cost)
+    assert np.array_equal(out.leaf, ref.leaf)
+    assert np.array_equal(out.served, ref.served)
+
+
+@pytest.mark.parametrize("backend", ["xla", "pallas"])
+def test_fused_clamp_off_identity(arena_pair, backend):
+    """clamp=False (FallbackPolicy mode 'off'): the row boxes widen to
+    +-inf so the in-kernel clip is the identity and nothing is flagged,
+    even for far-out-of-box queries."""
+    arena, _ = arena_pair
+    thetas = np.array([[3.3, 3.3], [40.0, -10.0]])
+    out = arena.evaluate(["a", "a"], thetas, clamp=False,
+                         backend=backend)
+    assert not np.any(out.clamped)
+    # The far-out row evaluates the RAW point: every lam is way
+    # negative, so it must come back unserved rather than clamped.
+    assert bool(out.served[0]) and not bool(out.served[1])
+
+
+def test_fused_within_backend_determinism(arena_pair):
+    """Same backend + same buffers + same query => bitwise-identical
+    results across repeated launches and batch compositions that keep
+    the row (torn-read audits in serve_bench rely on this)."""
+    arena, tables = arena_pair
+    cents = _centroids(tables["b"])[:8]
+    a = arena.evaluate("b", cents, backend="xla")
+    b = arena.evaluate("b", cents, backend="xla")
+    assert np.array_equal(a.u, b.u) and np.array_equal(a.cost, b.cost)
+    # Same rows embedded in a larger mixed batch: row-wise identical.
+    mixed_names = ["b"] * 8 + ["a"] * 8
+    mixed = np.concatenate([cents, _centroids(tables["a"])[:8]])
+    c = arena.evaluate(mixed_names, mixed, backend="xla")
+    assert np.array_equal(c.u[:8], a.u)
+    assert np.array_equal(c.cost[:8], a.cost)
+
+
+def test_pack_columns_layout():
+    """pack_columns invariants the kernel relies on: homogeneous-row
+    sentinel -BIG on unowned columns, +BIG padded vertices with zeroed
+    payloads, and shape/placement checks."""
+    rng = np.random.default_rng(9)
+    table = _synthetic_table(rng, L=5)
+    PV, K = 8, 8
+    bary, U, V = pallas_eval.pack_columns(table, n_cols=8, PV=PV, K=K)
+    assert bary.shape == (PV, K, 8) and U.shape == (PV, 8, 128)
+    assert V.shape == (PV, 8)
+    p = 2
+    # Unowned columns: score at the homogeneous row is -BIG => never
+    # win the argmax against any live column.
+    assert np.all(bary[:p + 1, p, 5:] == -pallas_eval._BIG)
+    # Padded vertices carry +BIG scores (never the min) + zero payload.
+    assert np.all(bary[p + 1:, p, :5] == pallas_eval._BIG)
+    assert np.all(U[p + 1:] == 0.0) and np.all(V[p + 1:] == 0.0)
+    with pytest.raises(ValueError):
+        pallas_eval.pack_columns(table, n_cols=4, PV=PV, K=K)

@@ -117,6 +117,36 @@ def test_host_sync_negative_static_python_in_jit():
     assert "host-sync-in-jit" not in rule_ids(found)
 
 
+def test_host_sync_positive_fused_arena_kernel_shape():
+    """Seeded violation shaped like the PR-16 fused arena kernel
+    (online/pallas_eval._fused_kernel): a multi-ref grid kernel with
+    VMEM scratch operands and pl.program_id tile logic, seeded with ONE
+    host cast in the kernel body.  Pins that the host-sync rule keeps
+    indexing pallas_call bodies at this arity/shape -- the fused
+    descent->eval->clamp kernel is exactly the region where a stray
+    host sync would stall every mixed-tenant batch."""
+    found = lint("""
+        import jax.numpy as jnp
+        from jax.experimental import pallas as pl
+
+        def _fused(th_ref, lb_ref, ub_ref, ext_ref, bary_ref, u_ref,
+                   v_ref, val_ref, idx_ref, u_out_ref, cost_ref,
+                   clamp_ref, best_val, best_idx, best_u, best_cost):
+            lt = pl.program_id(1)
+            thc = jnp.clip(th_ref[:], lb_ref[:], ub_ref[:])
+            scale = float(thc.max())        # seeded host cast
+            best_val[:] = best_val[:] * jnp.float32(scale)
+            val_ref[:] = best_val[:]
+
+        def launch(th, lb, ub, ext, bary, u, v, grid, shapes, scratch):
+            return pl.pallas_call(
+                _fused, grid=grid, out_shape=shapes,
+                scratch_shapes=scratch)(th, lb, ub, ext, bary, u, v)
+    """)
+    msgs = [f for f in found if f.rule == "host-sync-in-jit"]
+    assert len(msgs) == 1 and msgs[0].severity == "error", found
+
+
 def test_host_sync_pragma_line():
     found = lint("""
         import jax

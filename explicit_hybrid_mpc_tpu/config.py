@@ -457,6 +457,29 @@ class ServeConfig:
     # Observability mode/path, same semantics as PartitionConfig.obs.
     obs: str = "off"
     obs_path: Optional[str] = None
+    # Demand telemetry (obs/demand.py): 'on' captures per-leaf visit
+    # sketches, fallback geometry exemplars, and (with an oracle +
+    # demand_subopt_frac > 0) online suboptimality samples; 'off' is a
+    # no-op capture surface (<1% p99 budget, gated in tests).
+    demand: str = "off"
+    # Distinct leaves tracked exactly before the sketch degrades to
+    # count-min (memory stays O(demand_max_leaves) at any tree size).
+    demand_max_leaves: int = 4096
+    # Exponential-decay half-life (seconds) for the visit window: a
+    # snapshot reflects recent traffic, not process lifetime.
+    demand_decay_s: float = 300.0
+    # Per-cause reservoir size for fallback theta exemplars.
+    demand_reservoir: int = 64
+    # Deterministic sample fraction of served rows re-solved through
+    # the host oracle for the measured-subopt SLO (0 = off).
+    demand_subopt_frac: float = 0.0
+    # Eps budget for the health.subopt gate (0 = never fires).
+    demand_subopt_eps: float = 0.0
+    # Snapshot publish cadence (seconds) when demand_dir is set.
+    demand_snapshot_every_s: float = 30.0
+    # Snapshot root: <demand_dir>/<controller>/demand.{npz,json}.
+    # None = no cadence publishing (explicit snapshot() still works).
+    demand_dir: Optional[str] = None
 
     def __post_init__(self) -> None:
         if not is_pow2(self.max_batch):
@@ -488,3 +511,18 @@ class ServeConfig:
         if self.obs not in ("off", "jsonl", "full"):
             raise ValueError(f"unknown obs mode {self.obs!r} "
                              "(expected 'off', 'jsonl', or 'full')")
+        if self.demand not in ("off", "on"):
+            raise ValueError(f"unknown demand mode {self.demand!r} "
+                             "(expected 'off' or 'on')")
+        if self.demand_max_leaves < 1:
+            raise ValueError("demand_max_leaves must be >= 1")
+        if self.demand_decay_s <= 0:
+            raise ValueError("demand_decay_s must be > 0")
+        if self.demand_reservoir < 1:
+            raise ValueError("demand_reservoir must be >= 1")
+        if not 0.0 <= self.demand_subopt_frac <= 1.0:
+            raise ValueError("demand_subopt_frac must be in [0, 1]")
+        if self.demand_subopt_eps < 0:
+            raise ValueError("demand_subopt_eps must be >= 0")
+        if self.demand_snapshot_every_s <= 0:
+            raise ValueError("demand_snapshot_every_s must be > 0")

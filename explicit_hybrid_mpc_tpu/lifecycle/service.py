@@ -94,6 +94,15 @@ class LifecycleConfig:
     #: when the delta path falls back).  Bounds the delta chain a
     #: cold-started replica must walk.
     full_every: int = 0
+    #: Root of the serving fleet's demand snapshots
+    #: (<demand_dir>/<controller>/demand.{npz,json}, obs/demand.py):
+    #: when set, each warm rebuild loads the controller's latest
+    #: committed snapshot, maps its hot leaf rows to tree node ids
+    #: through the prior artifact's node_id.npy, and passes the
+    #: result to ``warm_rebuild(priority=...)`` so live-traffic
+    #: leaves re-certify first.  Best-effort: a missing/torn/stale
+    #: snapshot degrades to the default node ordering.
+    demand_dir: Optional[str] = None
 
     def __post_init__(self) -> None:
         if self.poll_s <= 0:
@@ -389,6 +398,7 @@ class RebuildService:
         with self._lock:
             st = self._ctl[name]
             prior = st.prior
+            prior_dir = st.prior_dir
             gen = st.generation
         problem = make(rev.problem, **dict(rev.problem_args))
         cfg2 = dataclasses.replace(
@@ -401,10 +411,17 @@ class RebuildService:
                                   obs=self.obs)
             reuse = None
         else:
+            priority = self._demand_priority(name, prior_dir)
             res = warm_rebuild(
                 problem, cfg2, prior, oracle=oracle, obs=self.obs,
-                strict_provenance=self.cfg.strict_provenance)
+                strict_provenance=self.cfg.strict_provenance,
+                priority=priority)
             reuse = res.stats.get("rebuild_reuse_frac")
+            if priority:
+                self.obs.event(
+                    "lifecycle.demand_priority", controller=name,
+                    seq=rev.seq, hot_nodes=len(priority),
+                    hinted=res.stats.get("rebuild_priority_hint"))
         rebuild_s = time.perf_counter() - t0
         row = self._publish(name, rev, res, gen)
         staleness = time.perf_counter() - rev.t_observed
@@ -456,6 +473,29 @@ class RebuildService:
                     f"{staleness:.1f}s after its revision was "
                     f"observed (SLA {self.cfg.sla_s:g}s): the rebuild "
                     "pipeline is not keeping up with plant drift")
+
+    def _demand_priority(self, name: str,
+                         prior_dir: Optional[str]
+                         ) -> Optional[dict[int, float]]:
+        """{node id: hits} hint from the controller's latest committed
+        demand snapshot (cfg.demand_dir), mapped through the PRIOR
+        artifact's node_id.npy -- the table the serving leaf rows
+        index.  Best-effort by contract: no snapshot dir, a torn
+        snapshot, or a missing prior artifact all return None (the
+        rebuild proceeds in default node order)."""
+        if self.cfg.demand_dir is None or prior_dir is None:
+            return None
+        from explicit_hybrid_mpc_tpu.obs import demand as demand_mod
+        try:
+            snap = demand_mod.load_demand(
+                os.path.join(self.cfg.demand_dir, name))
+            node_id = np.load(os.path.join(prior_dir, "node_id.npy"))
+            pr = demand_mod.priority_from_snapshot(snap, node_id)
+        except Exception as e:  # tpulint: disable=silent-except -- hint is best-effort; evented below
+            self.obs.event("lifecycle.demand_priority_skipped",
+                           controller=name, msg=repr(e))
+            return None
+        return pr or None
 
     def _publish(self, name: str, rev: Revision, res, gen: int) -> dict:
         """Delta-compressed publish + hot swap; returns the byte

@@ -48,6 +48,23 @@ Rule schema (all values floats; 0 disables a threshold rule):
                            (serve.ctl.<name>.fallback_frac gauges) ->
                            ``health.fallback_frac`` (warn) -- the
                            serving SLO from docs/serving.md
+``max_subopt``             measured serving suboptimality ceiling
+                           (serve.ctl.<name>.subopt_p99 gauges from
+                           the demand hub's online oracle re-solves,
+                           obs/demand.py; volume-gated on the
+                           ``.subopt_samples`` counter vs
+                           ``min_subopt_samples``, its OWN gate -- the
+                           sample budget is a tiny fraction of request
+                           volume) -> ``health.subopt`` (warn); 0 =
+                           off.  Set it to the build's eps budget:
+                           the paper's certificate as a measured SLO.
+                           The hub also emits its own in-stream
+                           ``health.subopt`` events, which any monitor
+                           ADOPTS; this rule is the external-tailer
+                           (obs_watch) complement reading the gauge
+``min_subopt_samples``     sample-volume floor for ``max_subopt``
+                           (three lucky re-solves must not alarm a
+                           fresh deploy)
 ``min_rebuild_reuse``      warm-rebuild reuse_frac floor
                            (rebuild.reuse_frac gauge, volume-gated on
                            ``min_rebuild_leaves`` prior leaves -- its
@@ -127,6 +144,8 @@ DEFAULT_RULES: dict[str, float] = {
     "max_device_failures": 3.0,
     "serve_p99_us": 0.0,
     "fallback_frac": 0.25,
+    "max_subopt": 0.0,
+    "min_subopt_samples": 20.0,
     "min_rebuild_reuse": 0.2,
     "min_rebuild_leaves": 500.0,
     "max_staleness_s": 0.0,
@@ -353,7 +372,8 @@ class HealthMonitor:
         for key in gauges:
             if key.startswith("serve.ctl.") and (
                     key.endswith(".p99_us")
-                    or key.endswith(".fallback_frac")):
+                    or key.endswith(".fallback_frac")
+                    or key.endswith(".subopt_p99")):
                 prefixes.add(key.rsplit(".", 1)[0])
         for pre in sorted(prefixes):
             ctl = pre[len("serve.ctl."):] if pre != "serve" else ""
@@ -379,6 +399,26 @@ class HealthMonitor:
                            "traffic has left the certified box or the "
                            "tree has holes -- rebuild or widen the "
                            "partition", key=f"fallback_frac:{ctl}")
+
+            # Measured suboptimality SLO (obs/demand.py online
+            # re-solves).  Gated on ITS OWN sample counter, not
+            # n_req: the sampler re-solves a tiny deterministic
+            # fraction of traffic, so min_solves_for_rates in
+            # REQUESTS would keep the rule silent long after the
+            # subopt estimate is statistically sound.
+            lim = self.rules["max_subopt"]
+            sp = gauges.get(f"{pre}.subopt_p99")
+            n_sub = counters.get(f"{pre}.subopt_samples", 0)
+            if lim > 0 and sp is not None \
+                    and n_sub >= self.rules["min_subopt_samples"] \
+                    and sp > lim:
+                self._fire("subopt", "warn", round(sp, 6), lim,
+                           f"measured serving suboptimality p99 "
+                           f"{sp:.4g} over {n_sub:.0f} sampled "
+                           f"re-solves{tag} (> {lim:g}): served "
+                           "answers exceed the eps certificate -- "
+                           "check artifact provenance / trigger a "
+                           "rebuild", key=f"subopt:{ctl}")
 
         # Warm-rebuild reuse collapse: a near-zero reuse fraction on a
         # LARGE prior tree means the revision invalidated (almost)

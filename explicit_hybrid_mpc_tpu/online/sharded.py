@@ -509,6 +509,13 @@ class ShardedDescent:
         return [0 if s is None else int(s["rows_global"].size)
                 for s in self._shards]
 
+    @property
+    def n_leaves(self) -> int:
+        """Total leaf-table rows across shards -- the global leaf-row
+        space ``EvalResult.leaf`` indexes (the demand hub records it as
+        the top-decile denominator hint, obs/demand.py)."""
+        return sum(self.shard_sizes())
+
 
 def shard_descent(dt: DescentTable, table: LeafTable,
                   n_shards: Optional[int] = None,

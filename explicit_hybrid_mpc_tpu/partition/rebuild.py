@@ -331,7 +331,8 @@ def warm_rebuild(problem, cfg: PartitionConfig, prior,
                  oracle: Oracle | None = None,
                  obs: "obs_lib.Obs | None" = None,
                  log: RunLog | None = None,
-                 strict_provenance: bool = False) -> RebuildResult:
+                 strict_provenance: bool = False,
+                 priority: dict | None = None) -> RebuildResult:
     """Rebuild a fully eps-certified tree for (problem, cfg) by
     transferring `prior` (see module docstring).
 
@@ -339,6 +340,20 @@ def warm_rebuild(problem, cfg: PartitionConfig, prior,
     (legacy artifacts cannot be validated against the revision; the
     default shims them with a stats note and proceeds -- the sweep
     itself re-proves every kept certificate either way).
+
+    priority: optional {tree node id: weight} demand hint
+    (obs/demand.py ``priority_from_snapshot`` maps a serving traffic
+    snapshot's leaf rows to node ids).  Invalidated leaves re-enter
+    the frontier hottest-first instead of in node order, so under an
+    interrupted or wall-bounded rebuild the leaves live traffic
+    actually visits are re-certified before cold corners.  It is an
+    ORDERING hint only: the same leaves are processed either way, so
+    a rebuild that re-certifies every invalidated leaf WITHOUT
+    splitting yields a bit-identical tree (node numbering only
+    diverges when splits allocate fresh node ids in a different
+    order; the tier-1 priority smoke pins the no-split case).  Nodes
+    missing from the map sort as weight 0 in node order -- a stale
+    snapshot degrades to the default ordering, never to an error.
 
     Returns a RebuildResult whose stats extend the ordinary build
     stats with::
@@ -349,6 +364,8 @@ def warm_rebuild(problem, cfg: PartitionConfig, prior,
         subdivision_solves          oracle solves issued by the frontier
         sweep_wall_s / rebuild_wall_s
         provenance_changed          field-level prior-vs-new stamp diff
+        rebuild_priority_hint       nodes matched by the demand hint
+        rebuild_priority_order      first frontier entries (hint runs)
     """
     t0 = time.perf_counter()
     # Fault-injection site (faults/injector.py): scripted failures at
@@ -730,9 +747,19 @@ def warm_rebuild(problem, cfg: PartitionConfig, prior,
                  sweep_s=round(sweep_s, 3))
 
     # Invalidated leaves re-enter the frontier IN NODE ORDER (the
-    # deterministic order a resumed build would see them) and the
-    # ordinary pipelined build runs to completion.
-    for node in sorted(invalid_nodes):
+    # deterministic order a resumed build would see them) unless a
+    # demand priority hint reorders them hottest-first (docstring);
+    # then the ordinary pipelined build runs to completion.
+    n_hinted = 0
+    if priority:
+        pr = {int(k): float(v) for k, v in priority.items()}
+        entry = sorted(invalid_nodes,
+                       key=lambda n2: (-pr.get(int(n2), 0.0), int(n2)))
+        n_hinted = sum(1 for n2 in invalid_nodes
+                       if pr.get(int(n2), 0.0) > 0)
+    else:
+        entry = sorted(invalid_nodes)
+    for node in entry:
         eng.frontier.append(node)
     res = eng.run()
 
@@ -759,6 +786,12 @@ def warm_rebuild(problem, cfg: PartitionConfig, prior,
         # pending commutation for free).
         rebuild_excl_events=n_excl_events,
         rebuild_excl_reverified=n_excl_ok,
+        # Demand-hint consumption (docstring): how many invalidated
+        # leaves the hint actually ranked, and the order the first of
+        # them entered the frontier -- the priority smoke asserts hot
+        # nodes lead it.
+        rebuild_priority_hint=n_hinted,
+        rebuild_priority_order=[int(n2) for n2 in entry[:16]],
     )
     return RebuildResult(res.tree, res.roots, stats)
 

@@ -62,6 +62,13 @@ def build_serve_parser() -> argparse.ArgumentParser:
     p.add_argument("--obs", choices=("off", "jsonl", "full"),
                    default="off")
     p.add_argument("--obs-path", metavar="FILE", default=None)
+    p.add_argument("--demand", choices=("off", "on"), default="off",
+                   help="demand telemetry (obs/demand.py): per-leaf "
+                        "traffic sketches + fallback geometry "
+                        "exemplars, snapshot to --demand-dir")
+    p.add_argument("--demand-dir", metavar="DIR", default=None,
+                   help="demand snapshot root "
+                        "(<dir>/<controller>/demand.{npz,json})")
     p.add_argument("--selftest", type=int, default=0, metavar="N",
                    help="serve N self-generated queries closed-loop, "
                         "print a JSON summary, and exit")
@@ -111,7 +118,8 @@ def serve_main(argv: list[str] | None = None) -> int:
             controller=args.controller, max_batch=args.max_batch,
             max_wait_us=args.max_wait_us, max_bucket=args.max_bucket,
             n_shards=args.shards, fallback=args.fallback,
-            obs=args.obs, obs_path=args.obs_path)
+            obs=args.obs, obs_path=args.obs_path,
+            demand=args.demand, demand_dir=args.demand_dir)
     except ValueError as e:
         raise SystemExit(str(e))
 
@@ -127,10 +135,13 @@ def serve_main(argv: list[str] | None = None) -> int:
         fallback = FallbackPolicy(lb, ub, mode=cfg.fallback,
                                   max_oracle_frac=cfg.max_oracle_frac,
                                   obs=o)
+    from explicit_hybrid_mpc_tpu.obs.demand import hub_from_serve_config
+
+    demand = hub_from_serve_config(cfg, obs=o)
     sched = RequestScheduler(registry, cfg.controller,
                              max_batch=cfg.max_batch,
                              max_wait_us=cfg.max_wait_us,
-                             fallback=fallback, obs=o)
+                             fallback=fallback, obs=o, demand=demand)
     try:
         if args.selftest:
             rng = np.random.default_rng(0)
@@ -172,5 +183,7 @@ def serve_main(argv: list[str] | None = None) -> int:
         return 0
     finally:
         sched.close()
+        if demand is not None:
+            demand.close()  # final snapshot when --demand-dir is set
         if o is not obs_lib.NOOP:
             o.close()

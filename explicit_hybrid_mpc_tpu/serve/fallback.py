@@ -28,6 +28,7 @@ health rule (obs/health.py) treats as an SLO.
 
 from __future__ import annotations
 
+import threading
 import weakref
 from typing import Optional
 
@@ -53,9 +54,19 @@ class FallbackPolicy:
     constructor box serves servers without root_bary.  `oracle`: an
     object with ``solve_vertices(thetas) -> VertexSolution``
     (oracle.Oracle / SOCOracle) or None; `max_oracle_frac` bounds
-    oracle re-solves to that fraction of ALL requests seen (running
+    oracle re-solves to that fraction of requests seen (running
     budget, so a burst of holes early cannot starve the budget
-    forever)."""
+    forever).
+
+    The budget is scoped PER CONTROLLER NAME (the `controller` /
+    `names` arguments below), not per policy instance: one policy is
+    routinely shared across tenants (several RequestSchedulers, or an
+    ArenaScheduler's whole mixed batch), and a single instance-global
+    counter pair would let one hot tenant's hole storm consume the
+    whole ``max_oracle_frac`` allowance and starve every other
+    tenant's re-solves -- each controller now earns budget from ITS
+    OWN request volume.  ``n_seen``/``n_oracle`` remain as
+    all-controller totals for summaries."""
 
     def __init__(self, lb: np.ndarray, ub: np.ndarray,
                  mode: str = "clamp", oracle=None,
@@ -74,8 +85,12 @@ class FallbackPolicy:
         # collectable; a recycled id() can never alias a stale box).
         self._boxes: "weakref.WeakKeyDictionary" = \
             weakref.WeakKeyDictionary()
-        self.n_seen = 0
-        self.n_oracle = 0
+        # Per-controller running budget (class docstring).  The lock
+        # covers the read-modify-write pair: schedulers for different
+        # tenants share one policy across worker threads.
+        self._budget_lock = threading.Lock()
+        self._seen: dict[str, int] = {}
+        self._oracle_n: dict[str, int] = {}
         self._ms = None
         if self._obs.enabled:
             m = self._obs.metrics
@@ -89,6 +104,42 @@ class FallbackPolicy:
     def _count(self, key: str, n: int) -> None:
         if self._ms and n:
             self._ms[key].inc(n)
+
+    # -- per-controller budget (class docstring) ---------------------------
+
+    @property
+    def n_seen(self) -> int:
+        """All-controller requests seen (summary/back-compat total)."""
+        with self._budget_lock:
+            return sum(self._seen.values())
+
+    @property
+    def n_oracle(self) -> int:
+        """All-controller oracle re-solves spent (summary total)."""
+        with self._budget_lock:
+            return sum(self._oracle_n.values())
+
+    def _see(self, controller: str, n: int) -> None:
+        with self._budget_lock:
+            self._seen[controller] = self._seen.get(controller, 0) + n
+
+    def _take_budget(self, controller: str, want: int) -> int:
+        """Claim up to `want` oracle re-solves from `controller`'s OWN
+        running allowance; returns the number granted."""
+        with self._budget_lock:
+            budget = int(self.max_oracle_frac
+                         * self._seen.get(controller, 0)) \
+                - self._oracle_n.get(controller, 0)
+            got = max(0, min(want, budget))
+            if got:
+                self._oracle_n[controller] = \
+                    self._oracle_n.get(controller, 0) + got
+            return got
+
+    def oracle_spent(self, controller: str) -> int:
+        """Oracle re-solves charged to one controller's budget."""
+        with self._budget_lock:
+            return self._oracle_n.get(controller, 0)
 
     def _box(self, server) -> tuple[np.ndarray, np.ndarray]:
         """The certified box of THIS server (see class docstring)."""
@@ -107,16 +158,23 @@ class FallbackPolicy:
             pass
         return box
 
-    def apply(self, thetas: np.ndarray, res: EvalResult, server
+    def box(self, server) -> tuple[np.ndarray, np.ndarray]:
+        """Public view of `server`'s certified box (lb, ub) -- the
+        demand hub's exceedance attribution reads it (obs/demand.py)."""
+        return self._box(server)
+
+    def apply(self, thetas: np.ndarray, res: EvalResult, server,
+              controller: str = "default"
               ) -> tuple[EvalResult, list[Optional[str]]]:
         """Resolve the not-inside rows of one evaluated batch.
 
         Returns (patched EvalResult, per-row outcome tags).  `server`
         is the SAME leased version the batch evaluated on -- the clamp
         re-evaluation must not straddle a hot swap (the scheduler holds
-        the lease across this call)."""
+        the lease across this call).  `controller` names the budget
+        account the batch charges (class docstring)."""
         B = thetas.shape[0]
-        self.n_seen += B
+        self._see(controller, B)
         tags: list[Optional[str]] = [None] * B
         bad = np.flatnonzero(~res.inside)
         if bad.size == 0 or self.mode == "off":
@@ -155,11 +213,9 @@ class FallbackPolicy:
         # running budget.
         left = bad[~served]
         if left.size and self.oracle is not None:
-            budget = int(self.max_oracle_frac * self.n_seen) \
-                - self.n_oracle
-            take = left[:max(0, budget)]
+            got = self._take_budget(controller, int(left.size))
+            take = left[:got]
             if take.size:
-                self.n_oracle += take.size
                 sol = self.oracle.solve_vertices(thetas[take])
                 dstar = np.asarray(sol.dstar)
                 hit = dstar >= 0
@@ -177,7 +233,7 @@ class FallbackPolicy:
                     tags[int(i)] = "oracle" if hit[k] else "unserved"
                 self._count("oracle", int(hit.sum()))
                 self._count("unserved", int((~hit).sum()))
-                left = left[max(0, budget):]
+                left = left[got:]
             if left.size:
                 self._count("unserved", left.size)
                 for i in left:
@@ -188,8 +244,8 @@ class FallbackPolicy:
                 tags[int(i)] = "unserved"
         return EvalResult(u=u, cost=cost, leaf=leaf, inside=inside), tags
 
-    def account_kernel(self, clamped: np.ndarray, served: np.ndarray
-                       ) -> list[Optional[str]]:
+    def account_kernel(self, clamped: np.ndarray, served: np.ndarray,
+                       names=None) -> list[Optional[str]]:
         """Count and tag one FUSED-KERNEL batch (serve/arena.py).
 
         The fused arena kernel clamps in-kernel and evaluates every row
@@ -220,11 +276,20 @@ class FallbackPolicy:
         oracle might have rescued are tagged 'unserved' here; route
         hole-heavy tenants through the host scheduler if oracle rescue
         matters more than launch fusion.
+
+        `names` (optional): per-row controller names for the mixed
+        arena batch, so each row credits ITS tenant's budget account
+        (class docstring); without it the whole batch charges
+        'default' -- acceptable only for single-tenant callers.
         """
         clamped = np.asarray(clamped, dtype=bool)
         served = np.asarray(served, dtype=bool)
         B = clamped.shape[0]
-        self.n_seen += B
+        if names is None:
+            self._see("default", B)
+        else:
+            for nm in set(names):
+                self._see(str(nm), sum(1 for x in names if x == nm))
         tags: list[Optional[str]] = [None] * B
         bad = clamped | ~served
         if not bad.any() or self.mode == "off":

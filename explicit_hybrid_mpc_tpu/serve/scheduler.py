@@ -38,6 +38,15 @@ tailer (scripts/obs_watch.py) see SLO breaches live, not only in the
 final close() snapshot.  The per-batch ``serve.eval`` heartbeat
 (emitted by the sharded evaluator) carries queue_depth +
 batch_fill_frac so obs_watch can alarm on serving stalls.
+
+Demand capture (obs/demand.py): both schedulers optionally hold a
+``DemandHub`` and make exactly ONE batched ``record`` call per
+(controller, micro-batch) AFTER results scatter back to tickets --
+leaf rows, fallback tags, certified box, and served costs, all arrays
+the serve path already produced.  Host-side and batched by
+construction (never per row, never in traced code), so tpulint's
+obs-in-hot-loop rule has nothing to flag and the demand=off overhead
+is one attribute test (the <1% p99 gate in tests/test_demand.py).
 """
 
 from __future__ import annotations
@@ -155,7 +164,8 @@ class RequestScheduler:
 
     def __init__(self, registry, controller: str,
                  max_batch: int = 256, max_wait_us: float = 2000.0,
-                 fallback=None, obs: "obs_lib.Obs | None" = None):
+                 fallback=None, obs: "obs_lib.Obs | None" = None,
+                 demand=None):
         if not config_mod.is_pow2(max_batch):
             raise ValueError(f"max_batch must be a power of two, "
                              f"got {max_batch}")
@@ -166,6 +176,10 @@ class RequestScheduler:
         self.max_batch = int(max_batch)
         self.max_wait_s = float(max_wait_us) * 1e-6
         self.fallback = fallback
+        # Demand telemetry hub (obs/demand.py DemandHub) or None; the
+        # off-path cost is this one attribute test per micro-batch.
+        self.demand = demand if demand is not None \
+            and getattr(demand, "enabled", False) else None
         self._obs = obs if obs is not None else obs_lib.NOOP
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
@@ -341,7 +355,8 @@ class RequestScheduler:
                     sum(self._fill_roll) / len(self._fill_roll), 4)
             res = srv.evaluate(thetas)
             if self.fallback is not None:
-                res, tags = self.fallback.apply(thetas, res, srv)
+                res, tags = self.fallback.apply(
+                    thetas, res, srv, controller=self.controller)
             else:
                 tags = [None] * B
         now = time.perf_counter()
@@ -378,6 +393,17 @@ class RequestScheduler:
             self._ms["p99"].set(float(np.percentile(lat_us, 99)))
             self._ms["fb_frac"].set(
                 sum(self._fb_roll) / len(self._fb_roll))
+        # Demand capture: one batched call, AFTER tickets are filled
+        # (telemetry never sits between a result and its caller).
+        # `srv` outlives the lease as a plain object reference; the
+        # box lookup only reads its root_bary.
+        if self.demand is not None:
+            box = self.fallback.box(srv) \
+                if self.fallback is not None else None
+            self.demand.record(
+                self.controller, thetas, res.leaf, tags, res.inside,
+                res.cost, box=box,
+                n_leaves=getattr(srv, "n_leaves", None))
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -440,7 +466,7 @@ class ArenaScheduler:
 
     def __init__(self, arena, max_batch: int = 256,
                  max_wait_us: float = 2000.0, fallback=None,
-                 obs: "obs_lib.Obs | None" = None):
+                 obs: "obs_lib.Obs | None" = None, demand=None):
         if not config_mod.is_pow2(max_batch):
             raise ValueError(f"max_batch must be a power of two, "
                              f"got {max_batch}")
@@ -450,6 +476,8 @@ class ArenaScheduler:
         self.max_batch = int(max_batch)
         self.max_wait_s = float(max_wait_us) * 1e-6
         self.fallback = fallback
+        self.demand = demand if demand is not None \
+            and getattr(demand, "enabled", False) else None
         self._obs = obs if obs is not None else obs_lib.NOOP
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
@@ -608,7 +636,8 @@ class ArenaScheduler:
         # leases every involved extent across the device round trip.
         res = self.arena.evaluate(names, thetas, clamp=not mode_off)
         if self.fallback is not None:
-            tags = self.fallback.account_kernel(res.clamped, res.served)
+            tags = self.fallback.account_kernel(res.clamped, res.served,
+                                                names=names)
         else:
             tags = [None] * B
         now = time.perf_counter()
@@ -655,6 +684,20 @@ class ArenaScheduler:
             self._ms["p99"].set(float(np.percentile(lat_us, 99)))
             self._ms["fb_frac"].set(
                 sum(self._fb_roll) / len(self._fb_roll))
+        # Demand capture, grouped per tenant (the hub's sketches are
+        # per-controller and ``res.leaf`` is controller-LOCAL, so the
+        # mixed batch splits cleanly); one batched call per tenant
+        # present, after tickets are filled.
+        if self.demand is not None:
+            names_arr = np.asarray(names)
+            for name in sorted(set(names)):
+                msk = names_arr == name
+                ext = self.arena.extent(name)
+                self.demand.record(
+                    name, thetas[msk], res.leaf[msk],
+                    [tags[i] for i in np.flatnonzero(msk)],
+                    res.served[msk], res.cost[msk],
+                    box=(ext.lb, ext.ub), n_leaves=ext.n_leaves)
 
     # -- lifecycle ---------------------------------------------------------
 

@@ -196,7 +196,22 @@ _ROW_EXTRAS = ("regions", "unit", "precision", "truncated",
                # (informational, not gated -- they are artifact-shaped,
                # not monotone).
                "tenants", "arena_controllers", "arena_resident_bytes",
-               "mixed_batch_fill", "delta_n_fresh", "delta_n_kept")
+               "mixed_batch_fill", "delta_n_fresh", "delta_n_kept",
+               # Demand-telemetry rows (serve_bench.py SERVE_BENCH_SKEW
+               # / obs/demand.py, ISSUE 17): traffic concentration +
+               # sampled suboptimality + the measured demand=on p99
+               # overhead ride next to the gated serve metrics
+               # (informational here; serve_bench's own exit gates and
+               # obs_report's diff flag enforce the bars).
+               "demand_top_decile_frac", "subopt_p99", "subopt_p50",
+               "subopt_samples", "subopt_eps",
+               "demand_leaves_observed", "demand_overhead_frac",
+               # Serve workload shape: gate() keys serve-row windows on
+               # it (skewed traffic concentrates the arena's working
+               # set and shifts p99/fallback_frac by construction, so a
+               # skewed capture is a DIFFERENT workload, not a
+               # regression signal for the unskewed one).
+               "skew")
 
 
 def summarize(bench: dict, source: str, mtime: float | None = None) -> dict:
@@ -302,11 +317,13 @@ def gate(candidate: dict, history: list[dict], tol: dict | None = None,
     """(regression flags, info lines) for `candidate` vs the trailing
     `window` of comparable history rows.
 
-    Comparable = same platform, not contended, no error, not the
-    candidate itself (EVERY row sharing the candidate's source name is
-    excluded: a re-captured file overwrote the artifact its older rows
-    described, and a candidate must never sit in its own comparison
-    base), and carrying the metric.  Each metric compares against the
+    Comparable = same platform, same serve workload shape (tenant
+    count + traffic skew -- a skewed-traffic demand capture must not
+    gate, or be gated by, the unskewed baseline), not contended, no
+    error, not the candidate itself (EVERY row sharing the candidate's
+    source name is excluded: a re-captured file overwrote the artifact
+    its older rows described, and a candidate must never sit in its
+    own comparison base), and carrying the metric.  Each metric compares against the
     MEAN of its trailing window -- a single noisy historical run
     cannot flip the gate the way a newest-only comparison can."""
     tol = tol or {}
@@ -320,10 +337,18 @@ def gate(candidate: dict, history: list[dict], tol: dict | None = None,
         info.append("candidate capture was CONTENDED: numbers are "
                     "known-degraded, gating skipped")
         return flags, info
+    def _workload(r: dict) -> tuple:
+        # Serve-row workload shape: tenant count + traffic skew.
+        # Legacy rows predate both fields (None == 1-tenant unskewed);
+        # build/rebuild/drift rows carry neither, so every non-serve
+        # pair compares equal and the key is a no-op for them.
+        return (r.get("tenants") or 0, float(r.get("skew") or 0.0))
+
     base = [r for r in history
             if r.get("platform") == candidate.get("platform")
             and not r.get("contended") and not r.get("error")
-            and r.get("source") != candidate.get("source")]
+            and r.get("source") != candidate.get("source")
+            and _workload(r) == _workload(candidate)]
     if not base:
         info.append(f"no comparable history rows (platform="
                     f"{candidate.get('platform')!r}): gate vacuously "

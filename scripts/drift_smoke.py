@@ -18,7 +18,13 @@ unless:
   value from one generation attributed to another);
 - end-to-end staleness p99 (revision observed -> new controller
   live) stays under the budget;
-- the daemon's own obs stream carries the lifecycle.* counters.
+- the daemon's own obs stream carries the lifecycle.* counters;
+- the serve load runs with demand telemetry ON (obs/demand.py wired
+  into the scheduler, ``LifecycleConfig.demand_dir`` wired into the
+  daemon so warm rebuilds consume the snapshot as a priority hint),
+  and on exit a COMMITTED demand snapshot exists for the controller,
+  strict-loads (sha-verified -- a torn snapshot fails here), and
+  carries at least one observed hot leaf.
 
 Usage (docs/perf.md pre-merge checklist, ~1-2 min CPU)::
 
@@ -73,6 +79,7 @@ def main(argv: list[str] | None = None) -> int:
                                                    LifecycleConfig,
                                                    RebuildService)
     from explicit_hybrid_mpc_tpu.obs import Obs
+    from explicit_hybrid_mpc_tpu.obs.demand import DemandHub, load_demand
     from explicit_hybrid_mpc_tpu.serve.registry import ControllerRegistry
     from explicit_hybrid_mpc_tpu.serve.scheduler import RequestScheduler
 
@@ -90,10 +97,18 @@ def main(argv: list[str] | None = None) -> int:
         controller="di", eps_a=args.eps, drift_arg="u_max",
         drift_frac=0.05, n_revisions=args.revisions, probe_T=10,
         seed=7)
+    # Demand telemetry rides the whole walk: the scheduler feeds the
+    # hub, frequent snapshots land under demand_dir, and the daemon
+    # (LifecycleConfig.demand_dir) consumes the committed snapshot as
+    # a warm-rebuild priority hint -- the full ISSUE-17 loop.
+    demand_dir = os.path.join(wd, "demand")
+    hub = DemandHub(mode="on", max_leaves=1024, snapshot_every_s=0.5,
+                    snapshot_dir=demand_dir, obs=obs)
     svc = RebuildService(
         source, build_cfg,
         cfg=LifecycleConfig(artifacts_root=os.path.join(wd, "art"),
-                            sla_s=args.staleness_budget),
+                            sla_s=args.staleness_budget,
+                            demand_dir=demand_dir),
         registry=registry, obs=obs)
     source.gate = (lambda: len(svc.generations) + svc.n_failures
                    >= source.n_emitted)
@@ -111,7 +126,7 @@ def main(argv: list[str] | None = None) -> int:
 
     # -- concurrent serve load across the remaining swaps ------------------
     sched = RequestScheduler(registry, "di", max_batch=32,
-                             max_wait_us=2000.0, obs=obs)
+                             max_wait_us=2000.0, obs=obs, demand=hub)
     served: list[tuple[np.ndarray, object]] = []
     dropped: list[str] = []
     stop = threading.Event()
@@ -138,6 +153,7 @@ def main(argv: list[str] | None = None) -> int:
     stop.set()
     loader.join(30)
     sched.close()
+    hub.close()  # final committed snapshot under demand_dir/di/
     svc.close()
     obs.close()
     summary = svc.summary()
@@ -167,6 +183,19 @@ def main(argv: list[str] | None = None) -> int:
     if p99 is None or p99 > args.staleness_budget:
         failures.append(f"staleness p99 {p99}s over the "
                         f"{args.staleness_budget}s budget")
+
+    # -- demand snapshot audit: committed, strict-loads, nonempty ----------
+    demand_leaves = 0
+    snap_dir = os.path.join(demand_dir, "di")
+    try:
+        snap = load_demand(snap_dir)  # raises CorruptArtifact if torn
+        demand_leaves = int(snap.leaf_ids.size)
+        if demand_leaves < 1:
+            failures.append("demand snapshot committed but observed "
+                            "zero hot leaves under live load")
+    except Exception as e:  # noqa: BLE001 -- the failure list IS the verdict
+        failures.append(f"demand snapshot missing or torn under "
+                        f"{snap_dir}: {e!r}")
 
     # -- torn-swap audit: every result bitwise vs its version's table ------
     by_version: dict[str, list[int]] = {}
@@ -200,6 +229,7 @@ def main(argv: list[str] | None = None) -> int:
         "wall_s": round(wall, 1), "summary": summary,
         "served": len(served), "dropped": len(dropped), "torn": torn,
         "versions_served": sorted(by_version),
+        "demand_leaves": demand_leaves,
         "failures": failures,
     }
     if args.json_out:
@@ -216,6 +246,7 @@ def main(argv: list[str] | None = None) -> int:
           f"({summary['delta_publishes']} delta), {len(served)} "
           f"requests served across swaps, 0 dropped / 0 torn, "
           f"staleness p99 {p99}s (budget {args.staleness_budget}s), "
+          f"demand snapshot committed ({demand_leaves} hot leaves), "
           f"{wall:.0f}s wall", file=sys.stderr)
     return 0
 

@@ -13,6 +13,12 @@ prints:
   warmstart_accept_rate, compiled-shape count) from its gauges;
 - serving: per-shard query-latency p50/p99, batch sizes, routing mode
   counts, shard imbalance;
+- demand telemetry (obs/demand.py): per-controller hot-leaf top-k,
+  traffic top-decile share, box-exceedance dims, and sampled
+  suboptimality p50/p99 + budget spent, off the serve.ctl.* demand
+  gauges and the bounded demand.snapshot events; the bench diff flags
+  a subopt_p99 worse than BOTH the last serve bench's figure and its
+  recorded eps budget;
 - a diff against a BENCH_*.json (default: the newest in the repo root)
   flagging >tol regressions in regions/sec and histogram p99s against
   the bench's own `metrics` block, plus iteration-economy regressions
@@ -250,6 +256,43 @@ def report(records: list[dict]) -> dict:
             ar["swap_us"] = out["histograms"]["serve.arena.swap_us"]
         if ar:
             out["arena"] = ar
+        # Demand telemetry (obs/demand.py, ISSUE 17): per-controller
+        # traffic-sketch + sampled-suboptimality figures off the
+        # serve.ctl.* demand gauges/counters.
+        dem: dict = {}
+        for key, v in out["gauges"].items():
+            if key.startswith("serve.ctl.") \
+                    and key.endswith(".demand_leaves"):
+                ctl = key[len("serve.ctl."):-len(".demand_leaves")]
+                pre = f"serve.ctl.{ctl}"
+                dem[ctl] = {
+                    "leaves_observed": int(v),
+                    "top_decile_frac": out["gauges"].get(
+                        f"{pre}.demand_top_decile_frac"),
+                    "subopt_p50": out["gauges"].get(f"{pre}.subopt_p50"),
+                    "subopt_p99": out["gauges"].get(f"{pre}.subopt_p99"),
+                    "subopt_samples": out["counters"].get(
+                        f"{pre}.subopt_samples"),
+                    "rows": out["counters"].get(f"{pre}.demand_rows"),
+                    "snapshots": out["counters"].get(
+                        f"{pre}.demand_snapshots"),
+                }
+        if dem:
+            out["demand"] = dem
+
+    # Hot-leaf / exceedance detail rides the demand.snapshot events,
+    # not the metrics (bounded top-k, docs/observability.md "Demand
+    # signals"); the LAST event per controller wins -- snapshots are
+    # cumulative views of the decayed window.
+    for r in records:
+        if r.get("kind") == "event" and r.get("name") == "demand.snapshot":
+            d = out.setdefault("demand", {}).setdefault(
+                str(r.get("controller")), {})
+            for k in ("hot", "exceed_dims", "leaves_observed",
+                      "top_decile_frac", "subopt_p50", "subopt_p99",
+                      "subopt_samples", "subopt_offered"):
+                if r.get(k) is not None:
+                    d[k] = r[k]
 
     # -- warnings: degraded-capture signals recorded in the stream ---------
     # (host.* gauges since PR 2, surfaced here since ISSUE 4 -- a report
@@ -429,6 +472,25 @@ def diff_bench(rep: dict, bench: dict, tol: float = 0.10) -> list[str]:
         flags.append(
             f"arena launch-amortization regression: {r_lpr:.3f} "
             f"launches/req vs bench {b_lpr:.3f}")
+    # Sampled-suboptimality regression (ISSUE 17): the run's worst
+    # per-controller subopt_p99 against the last serve bench's figure.
+    # Bench captures legitimately read 0 (the synthetic law is exact),
+    # so the comparison floors at the bench's own eps budget -- a run
+    # is flagged only when it is BOTH worse than the bench and over
+    # the budget the bench was gated under.
+    b_sp = bench.get("subopt_p99")
+    r_sps = [(ctl, d["subopt_p99"])
+             for ctl, d in rep.get("demand", {}).items()
+             if d.get("subopt_p99") is not None]
+    if b_sp is not None and r_sps:
+        floor = max((1 + tol) * b_sp, bench.get("subopt_eps") or 0.0)
+        for ctl, r_sp in r_sps:
+            if r_sp > floor:
+                flags.append(
+                    f"suboptimality regression [{ctl}]: sampled p99 "
+                    f"{r_sp:.4g} vs bench {b_sp:.4g} (eps budget "
+                    f"{bench.get('subopt_eps')}) -- the served answers "
+                    "drifted outside the certificate")
     # Serving headline: sharded us/query against the bench's large-L
     # figure, when both sides measured it.
     b_us = bench.get("large_l_sharded_us_per_query")
@@ -579,6 +641,25 @@ def render_text(rep: dict, flags: list[str], bench_path: str | None) -> str:
                 f"arena swap: {int(sw['count'])} publish(es), p50 "
                 f"{_fmt_lat(sw['p50'] / 1e6)}, p99 "
                 f"{_fmt_lat(sw['p99'] / 1e6)}")
+    dem = rep.get("demand")
+    if dem:
+        for ctl in sorted(dem):
+            d = dem[ctl]
+            hot = d.get("hot") or []
+            hot_s = " ".join(f"{int(i)}:{h:.0f}" for i, h in hot[:5])
+            tdf = d.get("top_decile_frac")
+            sp50, sp99 = d.get("subopt_p50"), d.get("subopt_p99")
+            sub = ("subopt p50/p99 "
+                   f"{sp50:.3g}/{sp99:.3g} over "
+                   f"{int(d.get('subopt_samples') or 0)} samples "
+                   f"({int(d.get('subopt_offered') or 0)} offered)"
+                   if sp99 is not None else "subopt not sampled")
+            ln.append(
+                f"demand [{ctl}]: {int(d.get('leaves_observed') or 0)} "
+                "leaves observed, top-decile "
+                + (f"{tdf:.2f}" if tdf is not None else "-")
+                + (f", hot [{hot_s}]" if hot_s else "")
+                + f", exceed dims {d.get('exceed_dims') or []}, {sub}")
     if bench_path:
         ln.append(f"bench diff vs {os.path.basename(bench_path)}: "
                   + ("OK" if not flags else f"{len(flags)} flag(s)"))

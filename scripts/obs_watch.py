@@ -8,7 +8,12 @@ divergence storm, rescue-rate threshold, warm-start acceptance
 collapse, shard imbalance, host contention), prints structured
 ``health.*`` events as JSON lines on stdout, and exits with the
 monitor's verdict so drivers can act on a sick build instead of
-burning the rest of a TPU allocation:
+burning the rest of a TPU allocation.  ``health.*`` events already IN
+the stream are adopted verbatim -- including ``health.subopt`` from a
+serving DemandHub (obs/demand.py: sampled suboptimality p99 over the
+eps budget) and the lifecycle daemon's staleness events -- and the
+``max_subopt`` metrics rule re-derives the same verdict from the
+``serve.ctl.*.subopt_p99`` gauges when only snapshots are present:
 
     exit 0  healthy (stream ended / --max-wall reached, no findings)
     exit 1  warn-level findings

@@ -1,0 +1,575 @@
+"""Demand telemetry (obs/demand.py, ISSUE 17): count-min sketch
+accuracy under an adversarial key stream, decay aging, reservoir
+determinism, off-mode cost, snapshot commit/torn-load semantics, the
+online suboptimality sampler's health gate, the per-controller
+fallback oracle budget (two-tenant starvation regression), and the
+warm-rebuild priority hint (hot leaves first, final tree
+bit-identical)."""
+
+import dataclasses
+import json
+import os
+import shutil
+import time
+
+import numpy as np
+import pytest
+
+from explicit_hybrid_mpc_tpu import obs as obs_lib
+from explicit_hybrid_mpc_tpu.config import PartitionConfig, ServeConfig
+from explicit_hybrid_mpc_tpu.obs import demand as demand_mod
+from explicit_hybrid_mpc_tpu.obs.demand import (CM_DEPTH, DemandHub,
+                                                DemandSnapshot,
+                                                ExceedHist, LeafSketch,
+                                                Reservoir,
+                                                SuboptSampler,
+                                                hub_from_serve_config,
+                                                load_demand,
+                                                priority_from_snapshot,
+                                                top_decile_frac)
+from explicit_hybrid_mpc_tpu.obs.health import HealthMonitor
+from explicit_hybrid_mpc_tpu.utils import atomic
+
+
+class _Clock:
+    """Injectable monotonic clock: decay/cadence under test control."""
+
+    def __init__(self, t: float = 0.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+
+# -- LeafSketch --------------------------------------------------------------
+
+
+def test_leafsketch_exact_mode_matches_truth():
+    clk = _Clock()
+    sk = LeafSketch(max_leaves=1024, decay_halflife_s=300.0, clock=clk)
+    rng = np.random.default_rng(0)
+    truth: dict[int, float] = {}
+    for _ in range(20):
+        batch = rng.integers(0, 100, size=64)
+        sk.update(batch)
+        for k in batch.tolist():
+            truth[k] = truth.get(k, 0.0) + 1.0
+    assert sk.mode == "exact"
+    for k, v in truth.items():
+        assert sk.estimate(k) == v
+    ids, hits = sk.items()
+    assert ids.size == len(truth)
+    # hits-descending, id-ascending on ties.
+    assert all(hits[i] >= hits[i + 1] for i in range(hits.size - 1))
+    assert sk.total == pytest.approx(sum(truth.values()))
+
+
+def test_leafsketch_countmin_adversarial_never_underestimates():
+    """Spill to count-min under a heavy-tailed stream over far more
+    distinct keys than max_leaves; pin the documented guarantees:
+    estimates NEVER underestimate, the 2N/width overestimate bound
+    holds for all but ~2^-CM_DEPTH of keys, and the true hottest key
+    stays at the top of the heavy-hitter candidates."""
+    clk = _Clock()
+    sk = LeafSketch(max_leaves=32, decay_halflife_s=300.0, seed=3,
+                    clock=clk)
+    rng = np.random.default_rng(7)
+    n_keys = 400
+    # Zipf-ish popularity over an adversarially wide key space (keys
+    # scattered across the int range so hash behavior is exercised).
+    keys = rng.integers(0, 2 ** 40, size=n_keys)
+    w = 1.0 / np.arange(1, n_keys + 1) ** 1.2
+    w /= w.sum()
+    truth: dict[int, float] = {}
+    for _ in range(40):
+        batch = rng.choice(keys, size=128, p=w)
+        sk.update(batch)
+        for k in batch.tolist():
+            truth[k] = truth.get(k, 0.0) + 1.0
+    assert sk.mode == "countmin"
+    total = sum(truth.values())
+    assert sk.total == pytest.approx(total)
+    bound = 2.0 * total / sk.width
+    n_over = 0
+    for k, v in truth.items():
+        est = sk.estimate(k)
+        assert est >= v - 1e-9, f"count-min underestimated key {k}"
+        if est > v + bound:
+            n_over += 1
+    # Markov bound per key: P(err > 2N/w) <= 2^-CM_DEPTH.  Allow 2x
+    # slack over the expectation (the stream is fixed-seed, so this is
+    # a deterministic regression pin, not a flaky statistical test).
+    assert n_over <= 2 * len(truth) * 2.0 ** -CM_DEPTH
+    # The genuinely hot head stays identifiable through the sketch.
+    hottest = max(truth, key=truth.get)
+    top_ids = [k for k, _h in sk.top(5)]
+    assert hottest in top_ids
+
+
+def test_leafsketch_decay_ages_old_traffic():
+    clk = _Clock()
+    sk = LeafSketch(max_leaves=64, decay_halflife_s=10.0, clock=clk)
+    sk.update(np.full(100, 1))
+    clk.t = 10.0  # one half-life
+    sk.update(np.full(60, 2))
+    assert sk.estimate(1) == pytest.approx(50.0)
+    assert sk.estimate(2) == pytest.approx(60.0)
+    # Recency wins: leaf 2 carried less raw traffic but leads now.
+    ids, _hits = sk.items()
+    assert ids[0] == 2
+    # Many half-lives out, the old key is noise; totals decay too.
+    clk.t = 210.0
+    assert sk.estimate(1) < 1e-3
+    assert sk.total == pytest.approx(110.0 * 0.5 ** 20, abs=1e-3)
+
+
+def test_top_decile_frac_shapes():
+    assert top_decile_frac(np.empty(0)) is None
+    assert top_decile_frac(np.array([5.0])) == 1.0
+    # 20 leaves, uniform: top-2 of 20 carry 10%.
+    assert top_decile_frac(np.full(20, 3.0)) == pytest.approx(0.1)
+    # One dominant leaf out of 10: near 1.
+    hits = np.r_[1000.0, np.full(9, 1.0)]
+    assert top_decile_frac(hits) > 0.99
+
+
+# -- Reservoir / ExceedHist --------------------------------------------------
+
+
+def test_reservoir_seeded_determinism_and_bound():
+    rng = np.random.default_rng(11)
+    stream = rng.uniform(-1, 1, size=(300, 3))
+    r1, r2 = Reservoir(k=16, seed=5), Reservoir(k=16, seed=5)
+    for lo in range(0, 300, 32):
+        r1.add(stream[lo:lo + 32])
+        r2.add(stream[lo:lo + 32])
+    assert r1.n_seen == r2.n_seen == 300
+    assert r1.sample().shape == (16, 3)
+    np.testing.assert_array_equal(r1.sample(), r2.sample())
+    # A different seed sees the same stream but keeps a different
+    # sample (the rng IS the sampling decision).
+    r3 = Reservoir(k=16, seed=6)
+    r3.add(stream)
+    assert not np.array_equal(r1.sample(), r3.sample())
+    # Every kept row really came from the stream.
+    seen = {tuple(row) for row in stream}
+    assert all(tuple(row) in seen for row in r1.sample())
+
+
+def test_exceed_hist_attributes_dimensions():
+    h = ExceedHist(3)
+    lb, ub = np.zeros(3), np.ones(3)
+    th = np.array([[1.5, 0.5, 0.5],    # above dim 0
+                   [2.0, 0.5, -0.2],   # above dim 0, below dim 2
+                   [0.5, 0.5, 0.5]])   # inside
+    h.update(th, lb, ub)
+    assert h.hi.tolist() == [2, 0, 0]
+    assert h.lo.tolist() == [0, 0, 1]
+    assert h.hot_dims() == [0, 2]
+
+
+# -- off-mode cost -----------------------------------------------------------
+
+
+def test_demand_off_mode_is_noop_and_under_one_percent():
+    """mode='off' must cost a single attribute test per batch: no
+    state, no snapshot, and per-record time under 1% of what one
+    serving micro-batch costs to evaluate (the scheduler calls record
+    once per batch, so this bounds the serve-path overhead)."""
+    from explicit_hybrid_mpc_tpu.online import descent, export, sharded
+    from explicit_hybrid_mpc_tpu.partition.synthetic import \
+        build_synthetic_tree
+
+    tree, roots = build_synthetic_tree(p=2, depth=6, n_u=2)
+    table = export.export_leaves(tree)
+    dt = descent.export_descent(tree, roots, table, stage=False)
+    srv = sharded.shard_descent(dt, table, n_shards=2)
+    rng = np.random.default_rng(2)
+    thetas = rng.uniform(0, 1, size=(32, 2))
+    srv.evaluate(thetas)  # warm the compiled path
+    reps = 20
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        srv.evaluate(thetas)
+    batch_s = (time.perf_counter() - t0) / reps
+
+    hub = DemandHub()  # defaults: mode='off'
+    assert not hub.enabled
+    leaf = np.arange(32)
+    served = np.ones(32, dtype=bool)
+    costs = np.zeros(32)
+    n = 2000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        hub.record("c", thetas, leaf, None, served, costs)
+    per_record_s = (time.perf_counter() - t0) / n
+    assert per_record_s < 0.01 * batch_s, (
+        f"off-mode record cost {per_record_s * 1e6:.2f}us vs batch "
+        f"{batch_s * 1e6:.1f}us")
+    # No state leaked, no snapshot produced.
+    assert hub._ctl == {}
+    assert hub.snapshot() == {}
+    hub.close()
+
+
+# -- hub capture + snapshot commit -------------------------------------------
+
+
+def _fill_hub(hub: DemandHub, name: str = "c") -> None:
+    """One deterministic capture mix: hot leaves 3/7, some fallback
+    rows outside the unit box on dim 0, one in-box hole."""
+    rng = np.random.default_rng(4)
+    box = (np.zeros(2), np.ones(2))
+    for _ in range(8):
+        thetas = rng.uniform(0, 1, size=(16, 2))
+        leaf = np.r_[np.full(10, 3), np.full(4, 7),
+                     rng.integers(0, 50, size=2)]
+        hub.record(name, thetas, leaf, None,
+                   np.ones(16, dtype=bool), np.zeros(16), box=box,
+                   n_leaves=64)
+    bad_th = np.array([[1.7, 0.5], [2.1, 0.4], [0.5, 0.5]])
+    tags = ["clamp", "clamp", "oracle"]
+    hub.record(name, bad_th, np.array([-1, -1, -1]), tags,
+               np.array([False, False, False]), np.zeros(3), box=box)
+
+
+def test_hub_snapshot_roundtrip_and_priority_mapping(tmp_path):
+    clk = _Clock()
+    o = obs_lib.Obs("jsonl")
+    hub = DemandHub(mode="on", max_leaves=256, reservoir_k=8,
+                    snapshot_dir=str(tmp_path), obs=o, clock=clk)
+    _fill_hub(hub)
+    metas = hub.snapshot()
+    hub.close(snapshot=False)
+    meta = metas["c"]
+    assert meta["schema"] == demand_mod.SNAPSHOT_SCHEMA
+    assert meta["sketch"]["mode"] == "exact"
+    assert meta["leaves_observed"] >= 2
+    assert meta["n_leaves_hint"] == 64
+    assert meta["hot"][0][0] == 3  # hottest leaf leads
+    assert meta["fallback"]["outside_seen"] == 2
+    assert meta["fallback"]["hole_seen"] == 1
+    assert meta["fallback"]["exceed_dims"] == [0]
+    # The committed artifact round-trips strict (sha-verified).
+    snap = load_demand(str(tmp_path / "c"))
+    assert snap.meta["npz_sha256"] == meta["npz_sha256"]
+    assert snap.leaf_ids[0] == 3
+    assert snap.top_decile_frac == pytest.approx(
+        meta["top_decile_frac"])
+    assert snap.res_outside.shape[0] == 2
+    assert snap.exceed_hi[0] == 2
+    # demand.snapshot event carries the render/report fields.
+    evs = [r for r in o.sink.records
+           if r.get("name") == "demand.snapshot"]
+    assert evs and evs[-1]["controller"] == "c"
+    for key in ("leaves_observed", "top_decile_frac", "hot",
+                "exceed_dims", "subopt_p50", "subopt_p99",
+                "subopt_samples", "subopt_offered"):
+        assert key in evs[-1]
+    o.close()
+    # Rebuild priority hint: rows map through the artifact's
+    # node_id table; rows outside it are dropped (best-effort).
+    node_id = np.arange(100, 150)  # leaf row r -> tree node 100 + r
+    pr = priority_from_snapshot(snap, node_id)
+    assert pr[103] == pytest.approx(float(snap.leaf_hits[0]))
+    assert all(100 <= n < 150 for n in pr)
+    tiny = priority_from_snapshot(snap, np.arange(2))  # rows dropped
+    assert set(tiny) <= {0, 1}
+
+
+def test_torn_snapshot_never_loads(tmp_path):
+    clk = _Clock()
+    hub = DemandHub(mode="on", snapshot_dir=str(tmp_path), clock=clk)
+    _fill_hub(hub)
+    hub.snapshot()
+    hub.close(snapshot=False)
+    good = tmp_path / "c"
+    assert load_demand(str(good)).leaf_ids.size  # baseline loads
+
+    # (a) npz landed, commit marker never did: refused.
+    torn_a = tmp_path / "torn_a"
+    shutil.copytree(good, torn_a)
+    os.remove(torn_a / "demand.json")
+    with pytest.raises(atomic.CorruptArtifact, match="never committed"):
+        load_demand(str(torn_a))
+
+    # (b) npz truncated/bit-flipped under a stale committed marker.
+    torn_b = tmp_path / "torn_b"
+    shutil.copytree(good, torn_b)
+    with open(torn_b / "demand.npz", "r+b") as f:
+        f.truncate(max(8, os.path.getsize(torn_b / "demand.npz") // 2))
+    with pytest.raises(atomic.CorruptArtifact, match="sha256 mismatch"):
+        load_demand(str(torn_b))
+
+    # (c) unknown schema major: refused before any array is trusted.
+    torn_c = tmp_path / "torn_c"
+    shutil.copytree(good, torn_c)
+    with open(torn_c / "demand.json") as f:
+        meta = json.load(f)
+    meta["schema"] = "demand-v999"
+    with open(torn_c / "demand.json", "w") as f:
+        json.dump(meta, f)
+    with pytest.raises(atomic.CorruptArtifact, match="unknown demand"):
+        load_demand(str(torn_c))
+
+    with pytest.raises(FileNotFoundError):
+        load_demand(str(tmp_path / "never_written"))
+
+
+# -- online suboptimality sampling -------------------------------------------
+
+
+class _GapOracle:
+    """solve_vertices stand-in: V* = 0 for every theta, so the folded
+    suboptimality equals the served cost exactly."""
+
+    def __init__(self):
+        self.n_calls = 0
+
+    def solve_vertices(self, thetas):
+        from types import SimpleNamespace
+
+        self.n_calls += thetas.shape[0]
+        K = thetas.shape[0]
+        return SimpleNamespace(Vstar=np.zeros(K),
+                               dstar=np.zeros(K, dtype=np.int64))
+
+
+def test_subopt_sampler_stride_and_budget():
+    s = SuboptSampler(frac=0.25, max_pending=4)
+    thetas = np.arange(16, dtype=np.float64).reshape(8, 2)
+    s.offer(thetas, np.arange(8.0), np.ones(8, dtype=bool))
+    assert s.n_offered == 2  # stride 4 over 8 served rows
+    s.offer(thetas, np.arange(8.0), np.ones(8, dtype=bool))
+    s.offer(thetas, np.arange(8.0), np.ones(8, dtype=bool))
+    # 6 offered total, pending capped at 4: overflow counted, never
+    # queued (the budget is the contract).
+    assert s.n_offered == 6
+    assert s.n_dropped == 2
+    th, v = s.take_pending()
+    assert th.shape == (4, 2) and v.shape == (4,)
+    assert len(s._pending_theta) == 0
+
+
+def test_hub_subopt_gauges_and_health_gate():
+    """The full online-subopt loop: deterministic stride sample ->
+    host-oracle re-solve -> p50/p99 gauges -> volume-gated
+    health.subopt event, both from the hub itself and from the
+    external max_subopt HealthMonitor rule over the same gauges."""
+    clk = _Clock()
+    o = obs_lib.Obs("jsonl")
+    oracle = _GapOracle()
+    hub = DemandHub(mode="on", subopt_frac=1.0, subopt_eps=0.01,
+                    oracle=oracle, obs=o, clock=clk)
+    thetas = np.random.default_rng(5).uniform(0, 1, size=(16, 2))
+    costs = np.full(16, 0.05)  # every served answer 0.05 suboptimal
+
+    # Below the volume gate: no alarm yet, gauges already live.
+    hub.record("c", thetas, np.zeros(16), None,
+               np.ones(16, dtype=bool), costs)
+    hub.drain_for_test()
+    g = o.metrics.snapshot()["gauges"]
+    assert g["serve.ctl.c.subopt_p50"] == pytest.approx(0.05)
+    assert g["serve.ctl.c.subopt_p99"] == pytest.approx(0.05)
+    assert not [r for r in o.sink.records
+                if r.get("name") == "health.subopt"]
+
+    # Over the gate (>= SUBOPT_MIN_SAMPLES): exactly one warn event
+    # (the refire cooldown holds under a frozen clock).
+    hub.record("c", thetas, np.zeros(16), None,
+               np.ones(16, dtype=bool), costs)
+    hub.drain_for_test()
+    hub.drain_for_test()
+    evs = [r for r in o.sink.records if r.get("name") == "health.subopt"]
+    assert len(evs) == 1
+    assert evs[0]["severity"] == "warn"
+    assert evs[0]["controller"] == "c"
+    assert evs[0]["value"] == pytest.approx(0.05)
+    assert oracle.n_calls == 32
+    assert hub.subopt_p99("c") == pytest.approx(0.05)
+
+    # External tailer's view: the max_subopt metrics rule re-derives
+    # the same verdict from the gauges (volume-gated on its own
+    # subopt_samples counter).
+    mon = HealthMonitor({"max_subopt": 0.01})
+    fired = mon.feed(o.flush_metrics())
+    assert [e["name"] for e in fired] == ["health.subopt"]
+    hub.close(snapshot=False)
+    o.close()
+
+
+def test_hub_subopt_clamps_knife_edge_negative_gaps():
+    """Served cost an ulp BELOW V* (interpolation knife edge) must
+    fold as 0, not negative: the SLO is an upper bound."""
+    from types import SimpleNamespace
+
+    class _HighOracle:
+        def solve_vertices(self, thetas):
+            K = thetas.shape[0]
+            return SimpleNamespace(Vstar=np.full(K, 1.0),
+                                   dstar=np.zeros(K, dtype=np.int64))
+
+    clk = _Clock()
+    hub = DemandHub(mode="on", subopt_frac=1.0, oracle=_HighOracle(),
+                    clock=clk)
+    thetas = np.zeros((8, 2))
+    hub.record("c", thetas, np.zeros(8), None, np.ones(8, dtype=bool),
+               np.full(8, 1.0 - 1e-12))
+    hub.drain_for_test()
+    p50, p99 = hub._ctl["c"].subopt.quantiles()
+    assert p50 == 0.0 and p99 == 0.0
+    hub.close(snapshot=False)
+
+
+def test_hub_from_serve_config():
+    assert hub_from_serve_config(ServeConfig()) is None
+    cfg = ServeConfig(demand="on", demand_max_leaves=77,
+                      demand_decay_s=12.5, demand_reservoir=9,
+                      demand_subopt_frac=0.25, demand_subopt_eps=0.3)
+    hub = hub_from_serve_config(cfg)
+    assert hub is not None and hub.enabled
+    assert hub.max_leaves == 77
+    assert hub.decay_halflife_s == 12.5
+    assert hub.reservoir_k == 9
+    assert hub.subopt_frac == 0.25
+    assert hub.subopt_eps == 0.3
+    hub.close()
+
+
+# -- per-controller fallback oracle budget (two-tenant regression) -----------
+
+
+def test_fallback_oracle_budget_scoped_per_controller():
+    """Regression: the oracle re-solve budget is earned per controller
+    NAME.  A hole-heavy tenant must not spend the allowance another
+    tenant's (mostly-certified) volume earned -- under the old
+    instance-global counters, tenant A below drains the shared pool
+    and B's occasional holes go unserved."""
+    from explicit_hybrid_mpc_tpu.online import descent, export, sharded
+    from explicit_hybrid_mpc_tpu.partition import geometry
+    from explicit_hybrid_mpc_tpu.partition.tree import LeafData, Tree
+    from explicit_hybrid_mpc_tpu.serve import FallbackPolicy
+
+    t = Tree(p=1, n_u=1)
+    r = t.add_root(np.array([[0.0], [1.0]]))
+    left, right, i, j, _ = geometry.bisect(t.vertices[r])
+    li, _ri = t.split(r, left, right, (i, j))
+    t.set_leaf(li, LeafData(delta_idx=0, vertex_inputs=np.ones((2, 1)),
+                            vertex_costs=np.zeros(2)))
+    table = export.export_leaves(t)
+    dt = descent.export_descent(t, [r], table, stage=False)
+    srv = sharded.shard_descent(dt, table, n_shards=2, granularity=1)
+
+    class _Oracle(_GapOracle):
+        def solve_vertices(self, thetas):
+            from types import SimpleNamespace
+
+            self.n_calls += thetas.shape[0]
+            K = thetas.shape[0]
+            return SimpleNamespace(dstar=np.zeros(K, dtype=np.int64),
+                                   u0=np.ones((K, 1, 1)),
+                                   Vstar=thetas.sum(axis=1))
+
+    fb = FallbackPolicy(np.zeros(1), np.ones(1), oracle=_Oracle(),
+                        max_oracle_frac=0.1)
+    rng = np.random.default_rng(6)
+
+    # Tenant B first: 100 certified (in-box, payload-carrying) rows.
+    # B's volume earns B -- and only B -- oracle allowance.
+    th_b = rng.uniform(0.01, 0.49, size=(100, 1))
+    _res, tags = fb.apply(th_b, srv.evaluate(th_b), srv,
+                          controller="B")
+    assert tags == [None] * 100
+
+    # Tenant A: a pure hole storm.  Its OWN 20 requests earn 2 oracle
+    # re-solves; the rest degrade to unserved.  (Globally-scoped, A
+    # would have claimed 0.1 * 120 = 12 here.)
+    th_a = rng.uniform(0.51, 0.99, size=(20, 1))
+    _res, tags_a = fb.apply(th_a, srv.evaluate(th_a), srv,
+                            controller="A")
+    assert tags_a.count("oracle") == 2
+    assert tags_a.count("unserved") == 18
+    assert fb.oracle_spent("A") == 2
+
+    # B comes back with 10 rows, half of them holes: B's accumulated
+    # 110-request volume covers all 5 -- A's storm starved nothing.
+    th_b2 = np.r_[rng.uniform(0.01, 0.49, size=(5, 1)),
+                  rng.uniform(0.51, 0.99, size=(5, 1))]
+    _res, tags_b2 = fb.apply(th_b2, srv.evaluate(th_b2), srv,
+                             controller="B")
+    assert tags_b2[:5] == [None] * 5
+    assert tags_b2[5:] == ["oracle"] * 5
+    assert fb.oracle_spent("B") == 5
+    # Summary totals still aggregate across controllers.
+    assert fb.n_seen == 130
+    assert fb.n_oracle == 7
+
+
+# -- warm_rebuild priority hint ----------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def depth_capped_prior():
+    """A depth-capped build whose best-effort leaves warm_rebuild
+    conservatively invalidates: they re-enter the frontier but CANNOT
+    split (the cap holds), pinning the no-split reorder case the
+    priority-hint contract promises bit-identity for."""
+    from explicit_hybrid_mpc_tpu.partition.frontier import \
+        build_partition
+    from explicit_hybrid_mpc_tpu.problems.registry import make
+
+    prob = make("double_integrator", N=3, theta_box=1.5)
+    cfg = PartitionConfig(problem="double_integrator", eps_a=0.3,
+                          backend="cpu", batch_simplices=128,
+                          max_depth=6)
+    return prob, cfg, build_partition(prob, cfg)
+
+
+def test_warm_rebuild_priority_hot_first_and_bit_identical(
+        depth_capped_prior):
+    from explicit_hybrid_mpc_tpu.online import export
+    from explicit_hybrid_mpc_tpu.partition.rebuild import warm_rebuild
+
+    prob, cfg, prior = depth_capped_prior
+    ra = warm_rebuild(prob, cfg, prior)
+    assert ra.stats["rebuild_leaves_invalidated"] > 0
+    assert ra.stats["rebuild_priority_hint"] == 0
+    order_a = ra.stats["rebuild_priority_order"]
+    assert order_a == sorted(order_a)  # default: node order
+
+    # Hint two of the invalidated nodes hot (weights descending).
+    hot = [order_a[-1], order_a[3]]
+    rb = warm_rebuild(prob, cfg, prior,
+                      priority={hot[0]: 100.0, hot[1]: 40.0})
+    assert rb.stats["rebuild_priority_hint"] == 2
+    order_b = rb.stats["rebuild_priority_order"]
+    # Hot leaves enter the frontier first, weight-descending; the
+    # unhinted rest follow in node order (weight-0 ties).
+    assert order_b[:2] == hot
+    rest = [n for n in order_a if n not in hot]
+    assert order_b[2:] == rest[:len(order_b) - 2]
+
+    # The hint is an ORDERING only: same leaves, no splits, and the
+    # final tree is identical node for node -- structure arrays
+    # bitwise, payload content per node (slot numbering is processing
+    # order, so compare through the indirection), ledger as a fact
+    # set, and the exported serving artifact bitwise.
+    assert len(ra.tree) == len(rb.tree) == len(prior.tree)
+    assert (rb.stats["rebuild_leaves_invalidated"]
+            == ra.stats["rebuild_leaves_invalidated"])
+    sa, sb = ra.tree.__getstate__(), rb.tree.__getstate__()
+    for key in ("children", "parent", "depth", "leaf_flags", "normal",
+                "offset", "split_edge", "n", "n_regions"):
+        va, vb = sa[key], sb[key]
+        assert np.array_equal(va, vb), f"tree field {key} diverged"
+    assert (set(map(tuple, sa["excl_events"] or []))
+            == set(map(tuple, sb["excl_events"] or [])))
+    ta = export.export_leaves(ra.tree)
+    tb = export.export_leaves(rb.tree)
+    names = ([f.name for f in dataclasses.fields(ta)]
+             if dataclasses.is_dataclass(ta) else list(ta._fields))
+    for name in names:
+        va, vb = getattr(ta, name), getattr(tb, name)
+        same = (np.array_equal(va, vb)
+                if isinstance(va, np.ndarray) else va == vb)
+        assert same, f"exported leaf table field {name} diverged"

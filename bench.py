@@ -530,6 +530,24 @@ def _kernel_tile_us(metrics: dict) -> float | None:
     return round(row["p50"] * 1e6, 1)
 
 
+def _cert_margin_p01(build_obs) -> float | None:
+    """p01 of the per-leaf certificate-margin histogram
+    (build.cert_margin, partition/frontier.py), or None when no leaf
+    certified / obs was off.  Reads the full bucket snapshot: the
+    summary() block only carries p50/p99 and the MARGIN FLOOR is the
+    figure of merit here."""
+    if build_obs is None or not build_obs.enabled:
+        return None
+    from explicit_hybrid_mpc_tpu.obs.metrics import quantile
+
+    h = build_obs.metrics.snapshot()["histograms"].get(
+        "build.cert_margin")
+    if not h or not h.get("count"):
+        return None
+    q = quantile(h, 0.01)
+    return round(q, 8) if q is not None else None
+
+
 def run(result: dict, monitor: ContentionMonitor | None = None) -> None:
     """The benchmark body; fills `result` incrementally so a late failure
     still ships every field gathered so far."""
@@ -719,6 +737,11 @@ def run(result: dict, monitor: ContentionMonitor | None = None) -> None:
     for seg in ("fill", "plan", "wait", "certify", "other"):
         result[f"cp_{seg}_frac"] = stats.get(f"cp_{seg}_frac")
     result["cp_checkpoint_s"] = stats.get("cp_checkpoint_s")
+    # Certificate-margin floor (ISSUE 19, ROADMAP item 4 evidence):
+    # p01 of the per-leaf eps-budget slack at certify time
+    # (build.cert_margin) -- the headroom a lower-precision refine
+    # must fit under.
+    result["cert_margin_p01"] = _cert_margin_p01(build_obs)
 
     # -- serial-oracle baseline estimate -----------------------------------
     # Point QPs and joint simplex QPs are structurally different sizes:

@@ -480,6 +480,18 @@ class ServeConfig:
     # Snapshot root: <demand_dir>/<controller>/demand.{npz,json}.
     # None = no cadence publishing (explicit snapshot() still works).
     demand_dir: Optional[str] = None
+    # Request tracing (obs/reqtrace.py): 'on' stamps every ticket's
+    # lifecycle and folds per micro-batch phase histograms
+    # (serve.ctl.<name>.phase.*_us summing to request wall), the
+    # queue_frac gauge, and the slowest-K exemplar ring; 'off' is a
+    # no-op (<1% p99 budget, gated in tests).
+    tracing: str = "off"
+    # Exemplar ring size: the K slowest requests per window keep their
+    # full stamp vectors.
+    trace_exemplar_k: int = 8
+    # Rolling window (seconds) behind the exemplar ring and the
+    # queue_frac gauge.
+    trace_window_s: float = 30.0
 
     def __post_init__(self) -> None:
         if not is_pow2(self.max_batch):
@@ -526,3 +538,10 @@ class ServeConfig:
             raise ValueError("demand_subopt_eps must be >= 0")
         if self.demand_snapshot_every_s <= 0:
             raise ValueError("demand_snapshot_every_s must be > 0")
+        if self.tracing not in ("off", "on"):
+            raise ValueError(f"unknown tracing mode {self.tracing!r} "
+                             "(expected 'off' or 'on')")
+        if self.trace_exemplar_k < 1:
+            raise ValueError("trace_exemplar_k must be >= 1")
+        if self.trace_window_s <= 0:
+            raise ValueError("trace_window_s must be > 0")

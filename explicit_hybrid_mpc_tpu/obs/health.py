@@ -48,6 +48,14 @@ Rule schema (all values floats; 0 disables a threshold rule):
                            (serve.ctl.<name>.fallback_frac gauges) ->
                            ``health.fallback_frac`` (warn) -- the
                            serving SLO from docs/serving.md
+``max_queue_frac``         queue-dominated tail: queue phase's share
+                           of request wall over the rolling window
+                           (serve.ctl.<name>.queue_frac gauges,
+                           obs/reqtrace.py; volume-gated like p99) ->
+                           ``health.serve_queue`` (warn) -- the
+                           "scale replicas, not kernels" signal; 0 =
+                           off (the acceptable share is
+                           deployment-specific)
 ``max_subopt``             measured serving suboptimality ceiling
                            (serve.ctl.<name>.subopt_p99 gauges from
                            the demand hub's online oracle re-solves,
@@ -144,6 +152,7 @@ DEFAULT_RULES: dict[str, float] = {
     "max_device_failures": 3.0,
     "serve_p99_us": 0.0,
     "fallback_frac": 0.25,
+    "max_queue_frac": 0.0,
     "max_subopt": 0.0,
     "min_subopt_samples": 20.0,
     "min_rebuild_reuse": 0.2,
@@ -373,6 +382,7 @@ class HealthMonitor:
             if key.startswith("serve.ctl.") and (
                     key.endswith(".p99_us")
                     or key.endswith(".fallback_frac")
+                    or key.endswith(".queue_frac")
                     or key.endswith(".subopt_p99")):
                 prefixes.add(key.rsplit(".", 1)[0])
         for pre in sorted(prefixes):
@@ -399,6 +409,22 @@ class HealthMonitor:
                            "traffic has left the certified box or the "
                            "tree has holes -- rebuild or widen the "
                            "partition", key=f"fallback_frac:{ctl}")
+
+            # Queue-dominated tail (obs/reqtrace.py queue_frac: the
+            # queue phase's share of request wall over the rolling
+            # window).  When the tail is queueing, kernel and shard
+            # tuning cannot move it -- the fix is capacity ("scale
+            # replicas, not kernels").  Same volume gate as p99.
+            lim = self.rules["max_queue_frac"]
+            qf = gauges.get(f"{pre}.queue_frac")
+            if lim > 0 and qf is not None and n_req >= min_n \
+                    and qf > lim:
+                self._fire("serve_queue", "warn", round(qf, 4), lim,
+                           f"{100 * qf:.1f}% of request wall spent "
+                           f"queued{tag} (> {100 * lim:.0f}%): the "
+                           "tail is queue-dominated -- scale replicas "
+                           "or raise max_batch, kernel tuning will "
+                           "not move it", key=f"serve_queue:{ctl}")
 
             # Measured suboptimality SLO (obs/demand.py online
             # re-solves).  Gated on ITS OWN sample counter, not

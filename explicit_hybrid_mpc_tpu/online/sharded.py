@@ -152,9 +152,10 @@ class ShardedDescent:
                              f"{_MIN_BUCKET}, got {self.max_bucket}")
         # Extra fields merged into every serve.eval heartbeat event:
         # the request scheduler (serve/scheduler.py) writes its
-        # queue_depth / batch_fill_frac here so stream consumers
-        # (scripts/obs_watch.py) can alarm on serving stalls, not just
-        # build stalls.
+        # queue_depth / batch_fill_frac here -- and, with request
+        # tracing on (obs/reqtrace.py), the rolling queue_frac -- so
+        # stream consumers (scripts/obs_watch.py) can alarm on serving
+        # stalls and queue-dominated tails, not just build stalls.
         self.heartbeat: dict = {}
         # Serving observability (obs subsystem): per-shard query-latency
         # histograms, batch sizes, routing counters, imbalance gauge.

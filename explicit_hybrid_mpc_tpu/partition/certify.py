@@ -187,6 +187,32 @@ def _passes(gap: float, Vstar_verts: np.ndarray, eps_a: float,
     return False
 
 
+def cert_margin(gap: float, Vstar_verts: np.ndarray, eps_a: float,
+                eps_r: float) -> float | None:
+    """Certificate slack: effective eps budget minus the certified gap
+    (>= 0 whenever ``_passes`` held).  The budget is the LARGEST
+    enabled bound -- passing under either eps_a or eps_r means the
+    slack against the looser one is what a precision change must not
+    consume.  None when no budget is enabled or the gap is not finite
+    (a -inf stage-1 gap means the candidate dominates outright; there
+    is no meaningful scalar slack to histogram).
+
+    Feeds ``build.cert_margin`` (frontier.py) -- the evidence base for
+    ROADMAP item 4's "f32 iterative refinement suffices": if the p01
+    margin dwarfs the f32 round-off on V, a lower-precision refine
+    cannot flip a certificate."""
+    budget = -np.inf
+    if eps_a > 0:
+        budget = eps_a
+    if eps_r > 0:
+        budget = max(budget,
+                     eps_r * float(np.min(np.abs(Vstar_verts))))
+    margin = budget - gap
+    if not np.isfinite(margin):
+        return None
+    return float(margin)
+
+
 def certify_suboptimal_stage1(sd: SimplexVertexData, eps_a: float,
                               eps_r: float) -> CertificateResult:
     """Vertex-data-only certification attempt.

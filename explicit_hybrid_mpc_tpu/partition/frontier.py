@@ -1444,6 +1444,12 @@ class FrontierEngine:
 
         n_leaves = n_splits = 0
         store_z = getattr(self.cfg, "store_vertex_z", True)
+        # Certificate-margin telemetry (ROADMAP item 4 evidence base):
+        # per certified leaf, the eps-budget slack the certificate
+        # passed with -- build.cert_margin's p01 is how much headroom
+        # a precision change (f32 refine) must fit under.
+        cert_h = self.obs.histogram("build.cert_margin") \
+            if self.obs.enabled else None
         for n in nodes:
             res = results[n]
             did_split = False
@@ -1454,6 +1460,12 @@ class FrontierEngine:
                     vertex_costs=res.vertex_costs,
                     vertex_z=res.vertex_z if store_z else None))
                 n_leaves += 1
+                if cert_h is not None:
+                    m = certify.cert_margin(
+                        res.gap, sds[n].Vstar,
+                        self.cfg.eps_a, self.cfg.eps_r)
+                    if m is not None:
+                        cert_h.observe(m)
             elif res.status == "infeasible":
                 pass  # leaf with no data: outside the feasible region
             else:  # split

@@ -47,6 +47,19 @@ the serve path already produced.  Host-side and batched by
 construction (never per row, never in traced code), so tpulint's
 obs-in-hot-loop rule has nothing to flag and the demand=off overhead
 is one attribute test (the <1% p99 gate in tests/test_demand.py).
+
+Request tracing (obs/reqtrace.py): both schedulers optionally hold a
+``ReqTrace`` hub under the same off-mode contract (``self.trace is
+None`` is the only off-path cost).  When on, tickets carry raw
+``perf_counter_ns`` submit/enqueue stamps (``Ticket.t_ns``), the
+worker takes batch-scoped stamps at seal / lease / launch entry /
+launch return / fallback end / reply, and ONE ``fold`` call per
+(controller, micro-batch) turns them into the
+``serve.ctl.<name>.phase.*_us`` decomposition (summing to request
+wall by construction), the ``queue_frac`` gauge, and the slowest-K
+exemplar ring.  Stamps are raw clock reads on the hot path; all
+emission happens at the batch fold -- the same obs-in-hot-loop
+discipline as demand capture.
 """
 
 from __future__ import annotations
@@ -71,6 +84,12 @@ from explicit_hybrid_mpc_tpu.online import sharded as sharded_mod
 #: an SLO breach surfaces within seconds at production rates.
 _ROLL_WINDOW = 1024
 
+#: Max age (seconds) of a rolling-window sample: after a traffic lull
+#: the fixed 1024-request window would otherwise serve an arbitrarily
+#: old p99 to the health rules on the first post-lull batch -- samples
+#: older than this are dropped before the gauge is computed.
+_ROLL_MAX_AGE_S = 60.0
+
 #: Minimum seconds between metrics-snapshot flushes from the worker
 #: loop.  The build flushes every metrics_every_steps steps
 #: (frontier.py); serving has no step counter, so the cadence is wall
@@ -82,6 +101,16 @@ METRICS_FLUSH_S = 2.0
 #: serve.batches): obs Counters are single-producer by contract, and
 #: several schedulers' threads share these two names.
 _AGG_LOCK = threading.Lock()
+
+
+def _prune_stale(lat_roll: deque, fb_roll: deque, now: float) -> None:
+    """Drop rolling-window samples older than _ROLL_MAX_AGE_S (entries
+    are (perf_counter, value) tuples, appended in time order)."""
+    cut = now - _ROLL_MAX_AGE_S
+    while lat_roll and lat_roll[0][0] < cut:
+        lat_roll.popleft()
+    while fb_roll and fb_roll[0][0] < cut:
+        fb_roll.popleft()
 
 
 @dataclasses.dataclass(frozen=True)
@@ -107,15 +136,21 @@ class ServeResult:
 
 
 class Ticket:
-    """Caller-side handle for one submission (k rows)."""
+    """Caller-side handle for one submission (k rows).
 
-    __slots__ = ("_evt", "_results", "_error", "t_submit", "n")
+    ``t_ns`` is the tracing stamp pair ``(submit_ns, enqueue_ns)``
+    (raw perf_counter_ns, obs/reqtrace.py) -- None unless the
+    scheduler holds an enabled ReqTrace, so tracing=off stays
+    byte-for-byte identical on the serve path."""
+
+    __slots__ = ("_evt", "_results", "_error", "t_submit", "t_ns", "n")
 
     def __init__(self, n: int):
         self._evt = threading.Event()
         self._results: list[Optional[ServeResult]] = [None] * n
         self._error: Optional[BaseException] = None
         self.t_submit = time.perf_counter()
+        self.t_ns: Optional[tuple[int, int]] = None
         self.n = n
 
     def _fill(self, offset: int, results: list[ServeResult]) -> None:
@@ -165,7 +200,7 @@ class RequestScheduler:
     def __init__(self, registry, controller: str,
                  max_batch: int = 256, max_wait_us: float = 2000.0,
                  fallback=None, obs: "obs_lib.Obs | None" = None,
-                 demand=None):
+                 demand=None, trace=None):
         if not config_mod.is_pow2(max_batch):
             raise ValueError(f"max_batch must be a power of two, "
                              f"got {max_batch}")
@@ -180,6 +215,12 @@ class RequestScheduler:
         # off-path cost is this one attribute test per micro-batch.
         self.demand = demand if demand is not None \
             and getattr(demand, "enabled", False) else None
+        # Request-trace hub (obs/reqtrace.py ReqTrace) or None; same
+        # off-mode contract as demand.
+        self.trace = trace if trace is not None \
+            and getattr(trace, "enabled", False) else None
+        self._t_seal_ns = 0
+        self._stall_over_ns = 0
         self._obs = obs if obs is not None else obs_lib.NOOP
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
@@ -259,12 +300,18 @@ class RequestScheduler:
                 f"theta width {thetas.shape[1]} does not match "
                 f"controller {self.controller!r} parameter dim {p}")
         t = Ticket(thetas.shape[0])
+        # Raw clock reads only on the hot path (obs/reqtrace.py):
+        # submit before the lock, enqueue once queued.
+        t_sub_ns = time.perf_counter_ns() if self.trace is not None \
+            else 0
         with self._cond:
             if self._closed:
                 raise RuntimeError("scheduler is closed")
             self._queue.append(_Pending(t, thetas))
             self._queued_rows += thetas.shape[0]
             self.n_requests += thetas.shape[0]
+            if self.trace is not None:
+                t.t_ns = (t_sub_ns, time.perf_counter_ns())
             if self._ms:
                 self._ms["requests"].inc(thetas.shape[0])
                 with _AGG_LOCK:
@@ -290,6 +337,12 @@ class RequestScheduler:
                         - time.perf_counter()
                     if self._queued_rows >= self.max_batch \
                             or budget <= 0 or self._closed:
+                        # Sleep-overshoot stall probe: a deadline
+                        # flush that woke past its budget measures
+                        # host interference (GC, scheduler preemption)
+                        # -- folded into serve.host.stall_us.
+                        if budget < 0 and self.trace is not None:
+                            self._stall_over_ns = int(-budget * 1e9)
                         break
                     self._cond.wait(timeout=budget)
                 elif self._closed:
@@ -310,6 +363,8 @@ class RequestScheduler:
                     self._queue.popleft()
             if self._ms:
                 self._ms["depth"].set(self._queued_rows)
+            if self.trace is not None:
+                self._t_seal_ns = time.perf_counter_ns()
             return out
 
     def _loop(self) -> None:
@@ -329,6 +384,8 @@ class RequestScheduler:
                 now = time.perf_counter()
                 if now - self._last_flush >= METRICS_FLUSH_S:
                     self._last_flush = now
+                    if self.trace is not None:
+                        self.trace.flush()
                     self._obs.flush_metrics()
 
     def _serve(self, entries) -> None:
@@ -337,6 +394,7 @@ class RequestScheduler:
         fill = B / min(sharded_mod._bucket(B), self.max_batch)
         self._fill_roll.append(fill)
         self.n_batches += 1
+        tr = self.trace
         # The lease is a context manager: release runs in its finally,
         # so ANY raise below -- evaluator error, fallback error, or an
         # injected serve.batch crash -- drains the ref and a retiring
@@ -344,6 +402,7 @@ class RequestScheduler:
         # timeout + health.lease_leak covers the only remaining leak
         # mode, a thread killed mid-lease).
         with self.registry.lease(self.controller) as ver:
+            ts_lease = time.perf_counter_ns() if tr is not None else 0
             faults_inj.fire("serve.batch", label=self.controller)
             srv = ver.server
             # Heartbeat context for the evaluator's serve.eval event
@@ -353,12 +412,19 @@ class RequestScheduler:
                 hb["queue_depth"] = self.queue_depth()
                 hb["batch_fill_frac"] = round(
                     sum(self._fill_roll) / len(self._fill_roll), 4)
+                if tr is not None:
+                    qf = tr.queue_frac(self.controller)
+                    if qf is not None:
+                        hb["queue_frac"] = round(qf, 4)
+            ts_eval0 = time.perf_counter_ns() if tr is not None else 0
             res = srv.evaluate(thetas)
+            ts_eval1 = time.perf_counter_ns() if tr is not None else 0
             if self.fallback is not None:
                 res, tags = self.fallback.apply(
                     thetas, res, srv, controller=self.controller)
             else:
                 tags = [None] * B
+            ts_fb_end = time.perf_counter_ns() if tr is not None else 0
         now = time.perf_counter()
         version = ver.version
         if self._ms:
@@ -368,6 +434,7 @@ class RequestScheduler:
             self._ms["batch_fill"].observe(fill)
             self._ms["fill"].set(
                 sum(self._fill_roll) / len(self._fill_roll))
+        trace_rows = [] if tr is not None else None
         lo = 0
         for ticket, off, rows in entries:
             k = rows.shape[0]
@@ -381,18 +448,41 @@ class RequestScheduler:
                             fallback=tags[lo + i],
                             latency_s=lat)
                 for i in range(k)]
-            self._lat_roll.extend([lat] * k)
+            self._lat_roll.extend([(now, lat)] * k)
             self._fb_roll.extend(
-                [0 if t is None else 1 for t in tags[lo:lo + k]])
+                [(now, 0 if t is None else 1)
+                 for t in tags[lo:lo + k]])
             if self._ms:
                 self._ms["req_s"].observe(lat, n=k)
+            if tr is not None and ticket.t_ns is not None:
+                trace_rows.append((
+                    ticket.t_ns, k,
+                    next((x for x in tags[lo:lo + k]
+                          if x is not None), None)))
             ticket._fill(off, results)
             lo += k
+        ts_done = time.perf_counter_ns() if tr is not None else 0
         if self._ms and self._lat_roll:
-            lat_us = np.asarray(self._lat_roll) * 1e6
-            self._ms["p99"].set(float(np.percentile(lat_us, 99)))
-            self._ms["fb_frac"].set(
-                sum(self._fb_roll) / len(self._fb_roll))
+            _prune_stale(self._lat_roll, self._fb_roll, now)
+            if self._lat_roll:
+                lat_us = np.asarray(
+                    [v for _t, v in self._lat_roll]) * 1e6
+                self._ms["p99"].set(float(np.percentile(lat_us, 99)))
+            if self._fb_roll:
+                self._ms["fb_frac"].set(
+                    sum(v for _t, v in self._fb_roll)
+                    / len(self._fb_roll))
+        # Trace fold: ONE call per micro-batch, after tickets are
+        # filled (attribution never sits between a result and its
+        # caller); stamps above are raw clock reads only.
+        if tr is not None and trace_rows:
+            tr.fold(self.controller, seal=self._t_seal_ns,
+                    lease=ts_lease, eval0=ts_eval0, eval1=ts_eval1,
+                    fb_end=ts_fb_end, done=ts_done, rows=trace_rows,
+                    fill=fill, version=version,
+                    extent=getattr(srv, "n_leaves", None),
+                    stall_ns=self._stall_over_ns)
+            self._stall_over_ns = 0
         # Demand capture: one batched call, AFTER tickets are filled
         # (telemetry never sits between a result and its caller).
         # `srv` outlives the lease as a plain object reference; the
@@ -466,7 +556,8 @@ class ArenaScheduler:
 
     def __init__(self, arena, max_batch: int = 256,
                  max_wait_us: float = 2000.0, fallback=None,
-                 obs: "obs_lib.Obs | None" = None, demand=None):
+                 obs: "obs_lib.Obs | None" = None, demand=None,
+                 trace=None):
         if not config_mod.is_pow2(max_batch):
             raise ValueError(f"max_batch must be a power of two, "
                              f"got {max_batch}")
@@ -478,6 +569,10 @@ class ArenaScheduler:
         self.fallback = fallback
         self.demand = demand if demand is not None \
             and getattr(demand, "enabled", False) else None
+        self.trace = trace if trace is not None \
+            and getattr(trace, "enabled", False) else None
+        self._t_seal_ns = 0
+        self._stall_over_ns = 0
         self._obs = obs if obs is not None else obs_lib.NOOP
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
@@ -550,12 +645,16 @@ class ArenaScheduler:
                 f"arena parameter dim {self.arena.p}")
         self.arena.extent(controller)   # raises KeyError if unpublished
         t = Ticket(thetas.shape[0])
+        t_sub_ns = time.perf_counter_ns() if self.trace is not None \
+            else 0
         with self._cond:
             if self._closed:
                 raise RuntimeError("scheduler is closed")
             self._queue.append(_ArenaPending(t, controller, thetas))
             self._queued_rows += thetas.shape[0]
             self.n_requests += thetas.shape[0]
+            if self.trace is not None:
+                t.t_ns = (t_sub_ns, time.perf_counter_ns())
             if self._ms:
                 with _AGG_LOCK:
                     self._ms["requests_all"].inc(thetas.shape[0])
@@ -581,6 +680,8 @@ class ArenaScheduler:
                         - time.perf_counter()
                     if self._queued_rows >= self.max_batch \
                             or budget <= 0 or self._closed:
+                        if budget < 0 and self.trace is not None:
+                            self._stall_over_ns = int(-budget * 1e9)
                         break
                     self._cond.wait(timeout=budget)
                 elif self._closed:
@@ -601,6 +702,8 @@ class ArenaScheduler:
                     self._queue.popleft()
             if self._ms:
                 self._ms["depth"].set(self._queued_rows)
+            if self.trace is not None:
+                self._t_seal_ns = time.perf_counter_ns()
             return out
 
     def _loop(self) -> None:
@@ -617,6 +720,8 @@ class ArenaScheduler:
                 now = time.perf_counter()
                 if now - self._last_flush >= METRICS_FLUSH_S:
                     self._last_flush = now
+                    if self.trace is not None:
+                        self.trace.flush()
                     self._obs.flush_metrics()
 
     def _serve(self, entries) -> None:
@@ -629,17 +734,25 @@ class ArenaScheduler:
         self._fill_roll.append(fill)
         self._mix_roll.append(len(set(names)))
         self.n_batches += 1
+        tr = self.trace
         faults_inj.fire("serve.batch", label="<arena>")
         mode_off = (self.fallback is not None
                     and self.fallback.mode == "off")
+        # Lease/put boundary stamps: arena.evaluate acquires the
+        # extent leases internally, so the put phase is the (near
+        # zero) gap between these two reads -- honest, not padded.
+        ts_lease = time.perf_counter_ns() if tr is not None else 0
+        ts_eval0 = time.perf_counter_ns() if tr is not None else 0
         # ONE launch for the whole mixed-tenant batch; arena.evaluate
         # leases every involved extent across the device round trip.
         res = self.arena.evaluate(names, thetas, clamp=not mode_off)
+        ts_eval1 = time.perf_counter_ns() if tr is not None else 0
         if self.fallback is not None:
             tags = self.fallback.account_kernel(res.clamped, res.served,
                                                 names=names)
         else:
             tags = [None] * B
+        ts_fb_end = time.perf_counter_ns() if tr is not None else 0
         now = time.perf_counter()
         if self._ms:
             with _AGG_LOCK:
@@ -650,6 +763,8 @@ class ArenaScheduler:
                 sum(self._mix_roll) / len(self._mix_roll))
             if self.n_requests:
                 self._ms["lpr"].set(self.n_batches / self.n_requests)
+        trace_rows: "dict[str, list] | None" = \
+            {} if tr is not None else None
         lo = 0
         for ticket, off, name, rows in entries:
             k = rows.shape[0]
@@ -672,18 +787,44 @@ class ArenaScheduler:
                 n_out = int(np.sum(res.clamped[lo:lo + k]))
                 if n_out:
                     cms["outside_box"].inc(n_out)
-            self._lat_roll.extend([lat] * k)
+            self._lat_roll.extend([(now, lat)] * k)
             self._fb_roll.extend(
-                [0 if t is None else 1 for t in tags[lo:lo + k]])
+                [(now, 0 if t is None else 1)
+                 for t in tags[lo:lo + k]])
             if self._ms:
                 self._ms["req_s"].observe(lat, n=k)
+            if tr is not None and ticket.t_ns is not None:
+                trace_rows.setdefault(name, []).append((
+                    ticket.t_ns, k,
+                    next((x for x in tags[lo:lo + k]
+                          if x is not None), None)))
             ticket._fill(off, results)
             lo += k
+        ts_done = time.perf_counter_ns() if tr is not None else 0
         if self._ms and self._lat_roll:
-            lat_us = np.asarray(self._lat_roll) * 1e6
-            self._ms["p99"].set(float(np.percentile(lat_us, 99)))
-            self._ms["fb_frac"].set(
-                sum(self._fb_roll) / len(self._fb_roll))
+            _prune_stale(self._lat_roll, self._fb_roll, now)
+            if self._lat_roll:
+                lat_us = np.asarray(
+                    [v for _t, v in self._lat_roll]) * 1e6
+                self._ms["p99"].set(float(np.percentile(lat_us, 99)))
+            if self._fb_roll:
+                self._ms["fb_frac"].set(
+                    sum(v for _t, v in self._fb_roll)
+                    / len(self._fb_roll))
+        # Trace fold, grouped per tenant (phase histograms and
+        # exemplars are per-controller; batch-scoped stamps are shared
+        # -- the mixed batch attributes the same launch to every
+        # tenant riding it).
+        if tr is not None and trace_rows:
+            for name, rws in trace_rows.items():
+                ext = self.arena.extent(name)
+                tr.fold(name, seal=self._t_seal_ns, lease=ts_lease,
+                        eval0=ts_eval0, eval1=ts_eval1,
+                        fb_end=ts_fb_end, done=ts_done, rows=rws,
+                        fill=fill, version=res.versions[name],
+                        extent=getattr(ext, "n_leaves", None),
+                        stall_ns=self._stall_over_ns)
+                self._stall_over_ns = 0
         # Demand capture, grouped per tenant (the hub's sketches are
         # per-controller and ``res.leaf`` is controller-LOCAL, so the
         # mixed batch splits cleanly); one batched call per tenant

@@ -152,6 +152,16 @@ GATED_METRICS: dict[str, tuple] = {
     # the trailing windows never mix metric families.
     "arena_swap_us": ("lower", 0.50, 20000.0),
     "batch_launches_per_req": ("lower", 0.25, 0.05),
+    # Request-trace queue share (scripts/serve_bench.py + obs/reqtrace,
+    # ISSUE 19): fraction of request wall spent waiting for the
+    # micro-batch to seal, over the trace's rolling window at the top
+    # offered rate.  Lower is better -- a queue_frac creep at constant
+    # p99 is the early "scale replicas, not kernels" signal
+    # (docs/observability.md queue_dominated runbook).  Closed-loop
+    # clients against the max_wait deadline make it workload-shaped
+    # and noisy on the contended CI host, so it gets a wide relative
+    # band plus an absolute slack.
+    "serve_queue_frac": ("lower", 0.25, 0.10),
 }
 
 _ROW_EXTRAS = ("regions", "unit", "precision", "truncated",
@@ -211,7 +221,29 @@ _ROW_EXTRAS = ("regions", "unit", "precision", "truncated",
                # set and shifts p99/fallback_frac by construction, so a
                # skewed capture is a DIFFERENT workload, not a
                # regression signal for the unskewed one).
-               "skew")
+               "skew",
+               # Request-trace rows (serve_bench.py + obs/reqtrace.py,
+               # ISSUE 19): the per-phase decomposition of the top-rate
+               # p99 (fractions of request wall), the phase-sum==wall
+               # invariant error, the slowest-exemplar binding, the
+               # trace on/off p99 overhead, and the gc-pause share of
+               # the sweep (collector now ON by default; gc_disabled
+               # marks --no-gc lineage rows).  Informational next to
+               # the gated serve_queue_frac -- serve_bench's own exit
+               # bars enforce the 2%/1% budgets at capture time.
+               "phase_queue_frac", "phase_seal_frac", "phase_put_frac",
+               "phase_launch_frac", "phase_fallback_frac",
+               "phase_reply_frac", "phase_sum_err_frac",
+               "exemplar_max_wall_us", "trace_exemplar_p99_bound",
+               "trace_overhead_frac", "serve_p99_trace_off_us",
+               "serve_p99_trace_on_us",
+               "gc_pause_frac", "gc_pauses", "gc_disabled",
+               # Certificate-margin telemetry (partition/certify.py
+               # cert_margin -> build.cert_margin histogram; bench.py
+               # rows): the 1st-percentile eps-suboptimality slack
+               # across certified leaves -- the ROADMAP item-4 evidence
+               # that f32 iterative refinement keeps margins positive.
+               "cert_margin_p01")
 
 
 def summarize(bench: dict, source: str, mtime: float | None = None) -> dict:

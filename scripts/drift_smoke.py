@@ -24,7 +24,12 @@ unless:
   daemon so warm rebuilds consume the snapshot as a priority hint),
   and on exit a COMMITTED demand snapshot exists for the controller,
   strict-loads (sha-verified -- a torn snapshot fails here), and
-  carries at least one observed hot leaf.
+  carries at least one observed hot leaf;
+- the serve load runs with request tracing ON (obs/reqtrace.py wired
+  into the scheduler) and on exit the ``serve.ctl.di.phase.*_us``
+  histograms exist and their per-phase means sum to the traced
+  request wall within 2% -- the phase-sum==wall invariant surviving
+  live hot swaps.
 
 Usage (docs/perf.md pre-merge checklist, ~1-2 min CPU)::
 
@@ -125,8 +130,14 @@ def main(argv: list[str] | None = None) -> int:
         return 2
 
     # -- concurrent serve load across the remaining swaps ------------------
+    # Request tracing rides the same load (obs/reqtrace.py): phase
+    # histograms + exemplars across every hot swap; audited below.
+    from explicit_hybrid_mpc_tpu.obs.reqtrace import ReqTrace
+
+    trace = ReqTrace(mode="on", obs=obs)
     sched = RequestScheduler(registry, "di", max_batch=32,
-                             max_wait_us=2000.0, obs=obs, demand=hub)
+                             max_wait_us=2000.0, obs=obs, demand=hub,
+                             trace=trace)
     served: list[tuple[np.ndarray, object]] = []
     dropped: list[str] = []
     stop = threading.Event()
@@ -197,6 +208,24 @@ def main(argv: list[str] | None = None) -> int:
         failures.append(f"demand snapshot missing or torn under "
                         f"{snap_dir}: {e!r}")
 
+    # -- request-trace audit: phase histograms exist + sum to wall ---------
+    ph = {k.rsplit(".phase.", 1)[1][:-3]: h
+          for k, h in obs.metrics.snapshot()["histograms"].items()
+          if k.startswith("serve.ctl.di.phase.")}
+    if not ph.get("wall", {}).get("count"):
+        failures.append("request tracing produced no serve.ctl.di"
+                        ".phase.* histograms under live load "
+                        "(obs/reqtrace.py scheduler wiring)")
+    else:
+        wall_mean = ph["wall"]["sum"] / ph["wall"]["count"]
+        phase_sum = sum(h["sum"] / h["count"] for p2, h in ph.items()
+                        if p2 != "wall" and h["count"])
+        if abs(phase_sum - wall_mean) > 0.02 * wall_mean:
+            failures.append(
+                f"trace phase means sum to {phase_sum:.1f}us vs "
+                f"request wall {wall_mean:.1f}us (>2%): a lifecycle "
+                "stamp went missing across the hot swaps")
+
     # -- torn-swap audit: every result bitwise vs its version's table ------
     by_version: dict[str, list[int]] = {}
     for i, (_th, r) in enumerate(served):
@@ -230,6 +259,7 @@ def main(argv: list[str] | None = None) -> int:
         "served": len(served), "dropped": len(dropped), "torn": torn,
         "versions_served": sorted(by_version),
         "demand_leaves": demand_leaves,
+        "trace_phases": sorted(ph),
         "failures": failures,
     }
     if args.json_out:

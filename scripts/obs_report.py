@@ -279,6 +279,53 @@ def report(records: list[dict]) -> dict:
                 }
         if dem:
             out["demand"] = dem
+        # Serve request tracing (obs/reqtrace.py, ISSUE 19): the
+        # per-phase critical-path decomposition of request wall
+        # (serve.ctl.*.phase.*_us histograms -- phases sum to wall by
+        # construction) plus the queue_frac gauge the queue_dominated
+        # health rule reads.
+        phases: dict[str, dict] = {}
+        for key, row in out["histograms"].items():
+            seg = key.rsplit(".phase.", 1)
+            if len(seg) != 2 or not seg[1].endswith("_us") \
+                    or not seg[0].startswith("serve.ctl."):
+                continue
+            ctl = seg[0][len("serve.ctl."):]
+            phases.setdefault(ctl, {})[seg[1][:-3]] = row
+        trc: dict = {}
+        for ctl, ph in phases.items():
+            d: dict = {"phases": ph}
+            wall = ph.get("wall")
+            if wall and wall.get("mean"):
+                d["fracs"] = {
+                    p: round(r["mean"] / wall["mean"], 4)
+                    for p, r in ph.items()
+                    if p != "wall" and r.get("mean") is not None}
+            qf = out["gauges"].get(f"serve.ctl.{ctl}.queue_frac")
+            if qf is not None:
+                d["queue_frac"] = qf
+            trc[ctl] = d
+        if trc:
+            out["reqtrace"] = trc
+        # Host-interference forensics next to the request phases: gc
+        # pauses (GcPauseRecorder) + scheduler flush-loop sleep
+        # overshoot (ReqTrace.note_stall).
+        hostf = {h: out["histograms"][f"serve.host.{h}"]
+                 for h in ("gc_pause_us", "stall_us")
+                 if f"serve.host.{h}" in out["histograms"]}
+        if hostf:
+            out["serve_host"] = hostf
+
+    # Exemplar digests ride the bounded serve.trace.exemplars events
+    # (obs/reqtrace.py flush); the LAST event per controller wins --
+    # the ring is a rolling window, so the final digest is the
+    # freshest slowest-K view.
+    for r in records:
+        if r.get("kind") == "event" \
+                and r.get("name") == "serve.trace.exemplars":
+            out.setdefault("reqtrace", {}).setdefault(
+                str(r.get("controller")), {})["exemplars"] = \
+                r.get("slowest")
 
     # Hot-leaf / exceedance detail rides the demand.snapshot events,
     # not the metrics (bounded top-k, docs/observability.md "Demand
@@ -491,6 +538,34 @@ def diff_bench(rep: dict, bench: dict, tol: float = 0.10) -> list[str]:
                     f"{r_sp:.4g} vs bench {b_sp:.4g} (eps budget "
                     f"{bench.get('subopt_eps')}) -- the served answers "
                     "drifted outside the certificate")
+    # Serve-phase regressions (ISSUE 19): this run's per-phase share
+    # of request wall vs the last serve BENCH row's decomposition
+    # (serve_bench writes phase_*_frac + serve_queue_frac).  A grown
+    # queue share is the "scale replicas, not kernels" signal even at
+    # flat p99; the +0.05 absolute slack keeps near-zero phases
+    # (put/seal) from flagging on noise-level shifts.  Directional:
+    # shrinking shares are not regressions.
+    for ctl, d in sorted((rep.get("reqtrace") or {}).items()):
+        fr = d.get("fracs") or {}
+        for pz in ("queue", "seal", "put", "launch", "fallback",
+                   "reply"):
+            b_f = bench.get(f"phase_{pz}_frac")
+            r_f = fr.get(pz)
+            if b_f and r_f is not None and r_f > (1 + tol) * b_f \
+                    and r_f > b_f + 0.05:
+                flags.append(
+                    f"serve phase regression [{ctl}]: {pz} "
+                    f"{100 * r_f:.0f}% of request wall vs bench "
+                    f"{100 * b_f:.0f}%")
+        b_qf = bench.get("serve_queue_frac")
+        r_qf = d.get("queue_frac")
+        if b_qf and r_qf is not None and r_qf > (1 + tol) * b_qf \
+                and r_qf > b_qf + 0.05:
+            flags.append(
+                f"queue_frac regression [{ctl}]: {r_qf:.2f} vs bench "
+                f"{b_qf:.2f} -- the tail is going queue-dominated; "
+                "scale replicas or raise max_batch "
+                "(docs/observability.md queue_dominated runbook)")
     # Serving headline: sharded us/query against the bench's large-L
     # figure, when both sides measured it.
     b_us = bench.get("large_l_sharded_us_per_query")
@@ -641,6 +716,49 @@ def render_text(rep: dict, flags: list[str], bench_path: str | None) -> str:
                 f"arena swap: {int(sw['count'])} publish(es), p50 "
                 f"{_fmt_lat(sw['p50'] / 1e6)}, p99 "
                 f"{_fmt_lat(sw['p99'] / 1e6)}")
+    trc = rep.get("reqtrace")
+    if trc:
+        for ctl in sorted(trc):
+            d = trc[ctl]
+            fr = d.get("fracs")
+            if fr:
+                segs = " / ".join(
+                    f"{p} {100 * fr[p]:.0f}%"
+                    for p in ("queue", "seal", "put", "launch",
+                              "fallback", "reply") if p in fr)
+                wall = (d.get("phases") or {}).get("wall") or {}
+                tail = ""
+                if wall.get("p99") is not None:
+                    tail = (f" (wall p50 {_fmt_lat(wall['p50'] / 1e6)} /"
+                            f" p99 {_fmt_lat(wall['p99'] / 1e6)})")
+                if d.get("queue_frac") is not None:
+                    tail += f", queue_frac {d['queue_frac']:.2f}"
+                ln.append(f"serve critical path [{ctl}]: {segs}{tail}")
+            ex = d.get("exemplars") or []
+            if ex:
+                e = ex[0]
+                st = e.get("stamps_us") or {}
+                ln.append(
+                    f"  slowest [{ctl}]: {e.get('wall_us', 0):.0f}us "
+                    f"(queued {st.get('seal', 0):.0f}us, launch ret "
+                    f"{st.get('launch_return', 0):.0f}us, version "
+                    f"{e.get('version')}, fill "
+                    f"{e.get('batch_fill', 0):.2f}"
+                    + (f", fallback {e['fallback']}"
+                       if e.get("fallback") else "") + ")")
+    sh = rep.get("serve_host")
+    if sh:
+        gp, stl = sh.get("gc_pause_us"), sh.get("stall_us")
+        bits = []
+        if gp:
+            bits.append(f"gc pauses {int(gp['count'])} "
+                        f"(p99 {_fmt_lat((gp['p99'] or 0) / 1e6)}, max "
+                        f"{_fmt_lat((gp['max'] or 0) / 1e6)})")
+        if stl:
+            bits.append(f"sched stalls {int(stl['count'])} "
+                        f"(p99 {_fmt_lat((stl['p99'] or 0) / 1e6)})")
+        if bits:
+            ln.append("serve host: " + ", ".join(bits))
     dem = rep.get("demand")
     if dem:
         for ctl in sorted(dem):

@@ -5,7 +5,10 @@ renders a FINISHED stream, this watchdog follows a LIVE one --
 ``artifacts/long_build.obs.jsonl`` while the campaign runs -- feeds
 every record through the rolling SLO rules (regions/sec stall,
 divergence storm, rescue-rate threshold, warm-start acceptance
-collapse, shard imbalance, host contention), prints structured
+collapse, shard imbalance, host contention, and -- when request
+tracing is on -- the volume-gated ``max_queue_frac`` queue-dominated
+rule over the ``serve.ctl.*.queue_frac`` gauges, e.g.
+``--rule max_queue_frac=0.5``; obs/reqtrace.py), prints structured
 ``health.*`` events as JSON lines on stdout, and exits with the
 monitor's verdict so drivers can act on a sick build instead of
 burning the rest of a TPU allocation.  ``health.*`` events already IN

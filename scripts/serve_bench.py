@@ -31,6 +31,24 @@ Env knobs (defaults target the tier-1 CPU config):
     SERVE_BENCH_MAX_BATCH=64 SERVE_BENCH_WAIT_US=2000
     SERVE_BENCH_OUTSIDE_FRAC=0.05 SERVE_BENCH_OUT=...
     SERVE_BENCH_SKEW=0 SERVE_BENCH_DEMAND=on
+    SERVE_BENCH_TRACE=on SERVE_BENCH_NO_GC=0
+
+**Request tracing + host forensics (ISSUE 19)**: with
+``SERVE_BENCH_TRACE=on`` (the default) both sweep modes run under a
+ReqTrace hub (obs/reqtrace.py): every per-rate row carries the
+per-phase mean decomposition (``phase_mean_us``) and its
+sum-vs-wall error (``phase_sum_err_frac`` -- the by-construction
+invariant, gated <= 2% in main()), the BENCH row decomposes the
+top-rate window into phase fractions + per-phase p50/p99 with the
+slowest-request exemplar digest bound to the p99 bucket, and a
+trace-off/on A/B pair (same 5-interleaved-window protocol as the
+demand overhead figure, skew mode only) measures
+``trace_overhead_frac`` (<= 1% budget).  The collector now RUNS
+during the measured sweep by default -- a GcPauseRecorder attributes
+every collection to ``serve.host.gc_pause_us`` and the row carries
+``gc_pause_frac``; pass ``--no-gc`` (or SERVE_BENCH_NO_GC=1) to
+restore the old gc-disabled capture for comparability with the
+r02/r03 lineage.
 
 **Skewed traffic + demand telemetry**: ``SERVE_BENCH_SKEW=a`` (a > 0)
 replaces the uniform in-box draw with a seeded Zipf(a)-over-Gaussian-
@@ -86,6 +104,153 @@ def _env(name: str, default, cast=float):
 
 def _percentile_us(lat_s: list[float], q: float) -> float:
     return round(float(np.percentile(np.asarray(lat_s) * 1e6, q)), 3)
+
+
+def _no_gc() -> bool:
+    """--no-gc / SERVE_BENCH_NO_GC=1: restore the historical
+    gc-disabled capture (comparable with the r02/r03 lineage rows);
+    default is collector ON + GcPauseRecorder attribution."""
+    return ("--no-gc" in sys.argv[1:]
+            or str(_env("SERVE_BENCH_NO_GC", "0", str)).lower()
+            in ("1", "on", "true"))
+
+
+def _make_trace(o):
+    """ReqTrace hub for the sweep (SERVE_BENCH_TRACE=off disables).
+    window_s >> sweep wall so the slowest request of the WHOLE run is
+    still in the exemplar ring when the digest is cut at the end."""
+    if str(_env("SERVE_BENCH_TRACE", "on", str)) == "off":
+        return None
+    from explicit_hybrid_mpc_tpu.obs import reqtrace
+
+    return reqtrace.ReqTrace(mode="on", exemplar_k=8, window_s=600.0,
+                             obs=o)
+
+
+def _phase_hists(o) -> dict:
+    """phase name -> cumulative histogram snapshot, summed over
+    controllers (every serve.ctl.<name>.phase.<phase>_us shares the
+    PHASE_BOUNDS_US bounds vector, so elementwise count sums are
+    exact)."""
+    out: dict[str, dict] = {}
+    if not o.enabled:
+        return out
+    for k, v in o.metrics.snapshot()["histograms"].items():
+        seg = k.rsplit(".phase.", 1)
+        if len(seg) != 2 or not seg[1].endswith("_us"):
+            continue
+        ph = seg[1][:-3]
+        cur = out.get(ph)
+        if cur is None:
+            out[ph] = {"bounds": list(v["bounds"]),
+                       "counts": list(v["counts"]),
+                       "count": v["count"], "sum": v["sum"],
+                       "min": v["min"], "max": v["max"]}
+        else:
+            cur["counts"] = [a + b for a, b in
+                             zip(cur["counts"], v["counts"])]
+            cur["count"] += v["count"]
+            cur["sum"] += v["sum"]
+            mins = [x for x in (cur["min"], v["min"]) if x is not None]
+            maxs = [x for x in (cur["max"], v["max"]) if x is not None]
+            cur["min"] = min(mins) if mins else None
+            cur["max"] = max(maxs) if maxs else None
+    return out
+
+
+def _hist_delta(after: dict, before: dict | None) -> dict:
+    """Histogram restricted to one rate window = cumulative-after
+    minus cumulative-before (counts are monotone)."""
+    if before is None:
+        return after
+    d = dict(after)
+    d["counts"] = [a - b for a, b in
+                   zip(after["counts"], before["counts"])]
+    d["count"] = after["count"] - before["count"]
+    d["sum"] = after["sum"] - before["sum"]
+    return d
+
+
+def _phase_rate_row(ph0: dict, ph1: dict) -> tuple[dict, dict]:
+    """Per-rate phase decomposition from cumulative-histogram deltas:
+    mean us per phase over THIS window plus the sum-vs-wall invariant
+    error.  Phases partition each request's wall by construction
+    (obs/reqtrace.py fold computes reply as the remainder), so the
+    means must agree to float rounding; main() gates the error at 2%
+    -- a larger gap means a stamp went missing."""
+    delta = {ph: _hist_delta(ph1[ph], ph0.get(ph)) for ph in ph1}
+    means = {ph: d["sum"] / d["count"]
+             for ph, d in delta.items() if d["count"] > 0}
+    wall = means.get("wall")
+    row: dict = {}
+    if means:
+        row["phase_mean_us"] = {ph: round(m, 2)
+                                for ph, m in sorted(means.items())}
+    if wall:
+        err = abs(sum(m for ph, m in means.items() if ph != "wall")
+                  - wall) / wall
+        row["phase_sum_err_frac"] = round(err, 6)
+    return row, delta
+
+
+def _trace_row(tr, o, top_delta: dict | None, sweep_wall_s: float,
+               gcrec, no_gc: bool, per_rate: list[dict]) -> dict:
+    """BENCH-row trace + host-forensics fields shared by both sweep
+    modes: top-rate phase fractions and per-phase p50/p99, queue_frac,
+    the exemplar digest with its p99-bucket binding, and the gc pause
+    budget share."""
+    row: dict = {
+        "gc_disabled": bool(no_gc),
+        "gc_pauses": len(gcrec.pauses) if gcrec is not None else None,
+        "gc_pause_frac": (
+            round(gcrec.total_pause_s() / sweep_wall_s, 6)
+            if gcrec is not None and sweep_wall_s > 0 else None),
+    }
+    if tr is None:
+        return row
+    errs = [r.get("phase_sum_err_frac") for r in per_rate]
+    errs = [e for e in errs if e is not None]
+    if errs:
+        # Worst rate's invariant error rides the history row; main()
+        # gates it at 2% per rate.
+        row["phase_sum_err_frac"] = max(errs)
+    from explicit_hybrid_mpc_tpu.obs.metrics import quantile
+    from explicit_hybrid_mpc_tpu.obs.reqtrace import PHASES
+
+    if top_delta:
+        means = {ph: d["sum"] / d["count"]
+                 for ph, d in top_delta.items() if d["count"] > 0}
+        wall = means.get("wall")
+        if wall:
+            for ph in PHASES:
+                m = means.get(ph)
+                row[f"phase_{ph}_frac"] = (round(m / wall, 4)
+                                           if m is not None else None)
+        row["phase_p50_us"] = {
+            ph: round(quantile(d, 0.50), 2)
+            for ph, d in sorted(top_delta.items()) if d["count"] > 0}
+        row["phase_p99_us"] = {
+            ph: round(quantile(d, 0.99), 2)
+            for ph, d in sorted(top_delta.items()) if d["count"] > 0}
+    gauges = o.metrics.snapshot()["gauges"] if o.enabled else {}
+    qfs = [v for k, v in gauges.items()
+           if k.startswith("serve.ctl.") and k.endswith(".queue_frac")]
+    row["serve_queue_frac"] = (round(sum(qfs) / len(qfs), 4)
+                               if qfs else None)
+    # Exemplar digest: the ring kept the slowest requests of the whole
+    # sweep (window_s >> sweep wall), so the slowest exemplar is the
+    # sample max and MUST sit at/above the traced-wall p99 -- main()
+    # gates the binding (0.999 covers log-linear interpolation).
+    ex = tr.exemplars()
+    row["trace_exemplars"] = ex[:3]
+    row["exemplar_max_wall_us"] = (round(ex[0]["wall_us"], 2)
+                                   if ex else None)
+    whole = _phase_hists(o).get("wall")
+    if ex and whole and whole["count"] > 0:
+        p99 = quantile(whole, 0.99)
+        row["trace_exemplar_p99_bound"] = bool(
+            p99 is not None and ex[0]["wall_us"] >= 0.999 * p99)
+    return row
 
 
 def _skew_sampler(skew: float, lb: np.ndarray, ub: np.ndarray):
@@ -257,9 +422,10 @@ def run_arena(out_path: str | None = None) -> dict:
             mode="on", max_leaves=1024, decay_halflife_s=300.0,
             reservoir_k=64, snapshot_every_s=max(0.5, secs / 2),
             snapshot_dir=demand_dir, obs=o)
+    tr = _make_trace(o)
     sched = ArenaScheduler(arena, max_batch=max_batch,
                            max_wait_us=wait_us, fallback=fallback,
-                           obs=o, demand=hub)
+                           obs=o, demand=hub, trace=tr)
     monitor = ContentionMonitor(
         interval_s=1.0, metrics=o.metrics if o.enabled else None).start()
 
@@ -273,13 +439,21 @@ def run_arena(out_path: str | None = None) -> dict:
     records: list[tuple[str, np.ndarray, object]] = []
     rec_lock = threading.Lock()
 
-    # The tree builds above leave a large object graph; on a 1-core
-    # host a major GC pass landing mid-sweep stalls the worker for
-    # tens of ms and single-handedly sets the first rate's p99.
-    # Collect now, then keep the collector off for the measured sweep
-    # (re-enabled right after the joins below).
+    # The tree builds above leave a large object graph; historically
+    # the sweep DISABLED the collector so a major pass could not land
+    # mid-sweep and set the first rate's p99.  That hid a real
+    # production cost -- default is now collector ON with every pause
+    # measured and attributed (serve.host.gc_pause_us -> the row's
+    # gc_pause_frac); --no-gc restores the old capture for lineage
+    # comparability.
+    from explicit_hybrid_mpc_tpu.obs.reqtrace import GcPauseRecorder
+
+    no_gc = _no_gc()
     gc.collect()
-    gc.disable()
+    if no_gc:
+        gc.disable()
+    gcrec = GcPauseRecorder(obs=o).start()
+    t_sweep0 = time.perf_counter()
 
     def client(cid: int, rate_per_client: float, t_end: float,
                lat_out: list, collect: bool):
@@ -309,10 +483,12 @@ def run_arena(out_path: str | None = None) -> dict:
             if sleep > 0:
                 time.sleep(sleep)
 
+    top_delta: dict | None = None
     for i, rate in enumerate(rates):
         top = i == len(rates) - 1
         lat: list[float] = []
         req0, bat0 = sched.n_requests, sched.n_batches
+        ph0 = _phase_hists(o) if tr is not None else {}
         t_end = time.perf_counter() + secs
         threads = [threading.Thread(
             target=client, args=(c, rate / n_clients, t_end, lat, top))
@@ -336,6 +512,14 @@ def run_arena(out_path: str | None = None) -> dict:
                if sched._mix_roll else 0.0)
         nreq = sched.n_requests - req0
         nbat = sched.n_batches - bat0
+        prow: dict = {}
+        if tr is not None:
+            # Let the worker finish the final batch's fold (scatter
+            # wakes clients a hair before the fold runs).
+            time.sleep(0.05)
+            prow, delta = _phase_rate_row(ph0, _phase_hists(o))
+            if top:
+                top_delta = delta
         per_rate.append({
             "offered_qps": rate,
             "achieved_qps": round(len(lat) / wall, 1),
@@ -346,9 +530,13 @@ def run_arena(out_path: str | None = None) -> dict:
             "launches_per_req": (round(nbat / nreq, 4) if nreq
                                  else None),
             "requests": len(lat),
+            **prow,
         })
 
-    gc.enable()
+    sweep_wall = time.perf_counter() - t_sweep0
+    gcrec.stop()
+    if no_gc:
+        gc.enable()
     gc.collect()
     drained = arena.wait_retired(e_v1, 10.0)
     sched.close()
@@ -459,8 +647,11 @@ def run_arena(out_path: str | None = None) -> dict:
                    "outside_frac": outside_frac, "secs": secs,
                    "capacity_cols": arena.capacity_cols,
                    "backend": arena.backend,
-                   "skew": skew, "demand": demand_on},
+                   "skew": skew, "demand": demand_on,
+                   "trace": tr is not None, "no_gc": no_gc},
         **demand_row,
+        **_trace_row(tr, o, top_delta, sweep_wall, gcrec, no_gc,
+                     per_rate),
     }
     o.close()
     _write_result(result, out_path)
@@ -531,9 +722,10 @@ def run(out_path: str | None = None) -> dict:
             oracle=_RefOracle(registry, "bench",
                               {"v1": srv1, "v2": srv2}),
             obs=o)
+    tr = _make_trace(o)
     sched = RequestScheduler(registry, "bench", max_batch=max_batch,
                              max_wait_us=wait_us, fallback=fallback,
-                             obs=o, demand=hub)
+                             obs=o, demand=hub, trace=tr)
 
     # Warm the compiled-shape set before the measured sweep: the pow-2
     # bucket discipline bounds it to log2(max_batch) programs per
@@ -587,9 +779,23 @@ def run(out_path: str | None = None) -> dict:
             if sleep > 0:
                 time.sleep(sleep)
 
+    # Collector stays ON for the measured sweep (pauses measured and
+    # attributed via serve.host.gc_pause_us -> gc_pause_frac); --no-gc
+    # restores the historical gc-disabled capture for lineage rows.
+    from explicit_hybrid_mpc_tpu.obs.reqtrace import GcPauseRecorder
+
+    no_gc = _no_gc()
+    gc.collect()
+    if no_gc:
+        gc.disable()
+    gcrec = GcPauseRecorder(obs=o).start()
+    t_sweep0 = time.perf_counter()
+
+    top_delta: dict | None = None
     for i, rate in enumerate(rates):
         top = i == len(rates) - 1
         lat: list[float] = []
+        ph0 = _phase_hists(o) if tr is not None else {}
         t_end = time.perf_counter() + secs
         threads = [threading.Thread(
             target=client, args=(c, rate / n_clients, t_end, lat, top))
@@ -607,6 +813,14 @@ def run(out_path: str | None = None) -> dict:
         wall = time.perf_counter() - t0
         fill = (sum(sched._fill_roll) / len(sched._fill_roll)
                 if sched._fill_roll else 0.0)
+        prow: dict = {}
+        if tr is not None:
+            # Let the worker finish the final batch's fold (scatter
+            # wakes clients a hair before the fold runs).
+            time.sleep(0.05)
+            prow, delta = _phase_rate_row(ph0, _phase_hists(o))
+            if top:
+                top_delta = delta
         per_rate.append({
             "offered_qps": rate,
             "achieved_qps": round(len(lat) / wall, 1),
@@ -614,6 +828,7 @@ def run(out_path: str | None = None) -> dict:
             "p99_us": _percentile_us(lat, 99) if lat else None,
             "batch_fill": round(fill, 4),
             "requests": len(lat),
+            **prow,
         })
 
     drained = registry.wait_retired(v1, 10.0)
@@ -631,23 +846,28 @@ def run(out_path: str | None = None) -> dict:
     # skew (capture) mode -- ten extra windows would double the
     # tier-1 smoke's wall for a figure only the committed BENCH row
     # gates.
+    def _ab_window() -> float | None:
+        """One top-rate closed-loop window; returns its request p99
+        (shared by the demand and trace A/B pairs below)."""
+        lat2: list[float] = []
+        t_end = time.perf_counter() + secs
+        ths = [threading.Thread(
+            target=client,
+            args=(c, rates[-1] / n_clients, t_end, lat2, False))
+            for c in range(n_clients)]
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join()
+        return _percentile_us(lat2, 99) if lat2 else None
+
     p99_off = p99_on = overhead = None
     offs: list = []
     ons: list = []
     if hub is not None and skew > 0:
         def _window(demand) -> float | None:
             sched.demand = demand
-            lat2: list[float] = []
-            t_end = time.perf_counter() + secs
-            ths = [threading.Thread(
-                target=client,
-                args=(c, rates[-1] / n_clients, t_end, lat2, False))
-                for c in range(n_clients)]
-            for t in ths:
-                t.start()
-            for t in ths:
-                t.join()
-            return _percentile_us(lat2, 99) if lat2 else None
+            return _ab_window()
 
         for _rep in range(5):
             offs.append(_window(None))
@@ -659,6 +879,33 @@ def run(out_path: str | None = None) -> dict:
             p99_on = min(ons)
             overhead = round((p99_on - p99_off) / p99_off, 4)
 
+    # trace=on vs trace=off A/B at the top offered rate, same
+    # interleaved five-pair min-p99 protocol as the demand figure:
+    # stamps are raw perf_counter_ns on the hot path and the fold runs
+    # once per micro-batch, so tracing must cost <= 1% of the
+    # traced-off request p99 (main() gates trace_overhead_frac).
+    # Skew-gated like the demand pair -- ten extra windows only for
+    # the committed capture, not the tier-1 smoke.
+    t_p99_off = t_p99_on = t_overhead = None
+    toffs: list = []
+    tons: list = []
+    if tr is not None and skew > 0:
+        for _rep in range(5):
+            sched.trace = None
+            toffs.append(_ab_window())
+            sched.trace = tr
+            tons.append(_ab_window())
+        toffs = [x for x in toffs if x is not None]
+        tons = [x for x in tons if x is not None]
+        if toffs and tons:
+            t_p99_off = min(toffs)
+            t_p99_on = min(tons)
+            t_overhead = round((t_p99_on - t_p99_off) / t_p99_off, 4)
+
+    sweep_wall = time.perf_counter() - t_sweep0
+    gcrec.stop()
+    if no_gc:
+        gc.enable()
     sched.close()
     host = monitor.summary()
 
@@ -740,8 +987,16 @@ def run(out_path: str | None = None) -> dict:
                    "n_shards": n_shards, "clients": n_clients,
                    "max_batch": max_batch, "max_wait_us": wait_us,
                    "outside_frac": outside_frac, "secs": secs,
-                   "skew": skew, "demand": demand_on},
+                   "skew": skew, "demand": demand_on,
+                   "trace": tr is not None, "no_gc": no_gc},
         **demand_row,
+        **_trace_row(tr, o, top_delta, sweep_wall, gcrec, no_gc,
+                     per_rate),
+        "serve_p99_trace_off_us": t_p99_off,
+        "serve_p99_trace_on_us": t_p99_on,
+        "trace_overhead_frac": t_overhead,
+        **({"trace_ab_windows": {"off": toffs, "on": tons}}
+           if toffs or tons else {}),
     }
     o.close()
     _write_result(result, out_path)
@@ -781,6 +1036,21 @@ def main() -> int:
         # demand=on must cost <= 1% of the demand=off p99 (negative
         # overhead is run-to-run noise in our favor -- accepted).
         ok = ok and oh <= 0.01
+    # Tracing bars (ISSUE 19): the phase decomposition must sum to the
+    # measured request wall within 2% at EVERY offered rate (it is
+    # exact by construction, so a miss means a lost stamp), the
+    # slowest exemplar must bind to the traced-wall p99 bucket, and
+    # tracing must cost <= 1% of the traced-off p99.
+    errs = [r.get("phase_sum_err_frac") for r in result["rates"]]
+    errs = [e for e in errs if e is not None]
+    if errs:
+        ok = ok and max(errs) <= 0.02
+    exb = result.get("trace_exemplar_p99_bound")
+    if exb is not None:
+        ok = ok and exb
+    toh = result.get("trace_overhead_frac")
+    if toh is not None:
+        ok = ok and toh <= 0.01
     return 0 if ok else 1
 
 

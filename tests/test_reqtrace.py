@@ -1,0 +1,481 @@
+"""Serve request tracing (obs/reqtrace.py, ISSUE 19): the per-ticket
+stamp-vector fold (phase histograms summing to request wall BY
+CONSTRUCTION, for both schedulers), the lock-free slowest-K exemplar
+ring under concurrent submitters across a hot swap, the off-mode
+byte-for-byte no-op + on-mode overhead A/B, the rolling-window max-age
+cut behind the p99 gauge (a stale window must stop firing
+``serve_p99_us``), the volume-gated ``max_queue_frac`` ->
+``health.serve_queue`` rule, and the host-interference forensics
+(``GcPauseRecorder`` gc-pause capture, ``note_stall`` rate limiting).
+"""
+
+import gc
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from explicit_hybrid_mpc_tpu import obs as obs_lib
+from explicit_hybrid_mpc_tpu.config import ServeConfig
+from explicit_hybrid_mpc_tpu.obs import reqtrace
+from explicit_hybrid_mpc_tpu.obs.health import HealthMonitor
+from explicit_hybrid_mpc_tpu.obs.reqtrace import (GcPauseRecorder, ReqTrace,
+                                                  _Ring,
+                                                  trace_from_serve_config)
+from explicit_hybrid_mpc_tpu.online import descent, export, sharded
+from explicit_hybrid_mpc_tpu.partition.synthetic import build_synthetic_tree
+from explicit_hybrid_mpc_tpu.serve import (ArenaScheduler, ControllerRegistry,
+                                           DeviceArena, FallbackPolicy,
+                                           RequestScheduler)
+
+
+def _server(obs=None, scale=1.0, depth=6):
+    tree, roots = build_synthetic_tree(p=2, depth=depth, n_u=2)
+    if scale != 1.0:
+        tree._pl_inputs[:] *= scale
+        tree._pl_costs[:] *= scale
+    table = export.export_leaves(tree)
+    dt = descent.export_descent(tree, roots, table, stage=False)
+    return sharded.shard_descent(dt, table, n_shards=2, obs=obs)
+
+
+def _synthetic_table(rng, L=24, p=2, n_u=2):
+    """Disjoint unit-grid simplices (test_arena idiom)."""
+    from explicit_hybrid_mpc_tpu.partition import geometry
+
+    base = np.vstack([np.zeros(p), np.eye(p)])
+    side = int(np.ceil(np.sqrt(L)))
+    bary, U, V = [], [], []
+    for i in range(L):
+        off = np.array([i % side, i // side], dtype=float)[:p]
+        verts = 0.8 * base + off + 0.1 * rng.uniform(size=p)
+        bary.append(geometry.barycentric_matrix(verts))
+        U.append(rng.normal(size=(p + 1, n_u)))
+        V.append(np.abs(rng.normal(size=p + 1)))
+    return export.LeafTable(
+        bary_M=np.stack(bary), U=np.stack(U), V=np.stack(V),
+        delta=np.zeros(L, dtype=np.int64),
+        node_id=np.arange(L, dtype=np.int64))
+
+
+_BOX = (np.zeros(2), np.full(2, 8.0))
+
+_STAMP_ORDER = ("enqueue", "seal", "lease", "put", "launch_return",
+                "fallback_end", "reply")
+
+
+def _phase_hists(o, ctl):
+    pre = f"serve.ctl.{ctl}.phase."
+    return {k[len(pre):-3]: h
+            for k, h in o.metrics.snapshot()["histograms"].items()
+            if k.startswith(pre)}
+
+
+def _assert_phases_sum_to_wall(ph, n_expected):
+    assert set(reqtrace.PHASES) | {"wall"} == set(ph)
+    n = ph["wall"]["count"]
+    assert n == n_expected
+    wall_mean = ph["wall"]["sum"] / n
+    phase_sum = sum(ph[p]["sum"] / ph[p]["count"] for p in reqtrace.PHASES)
+    # Arithmetic identity (reply is computed as the remainder), so the
+    # tolerance covers float summation order only -- not sampling.
+    assert abs(phase_sum - wall_mean) <= 1e-6 * wall_mean
+    assert all(ph[p]["count"] == n for p in reqtrace.PHASES)
+
+
+# -- phase-sum == wall invariant, both schedulers ---------------------------
+
+
+def test_request_scheduler_phase_sum_equals_wall(rng):
+    o = obs_lib.Obs("jsonl")
+    srv = _server(obs=o)
+    reg = ControllerRegistry(obs=o)
+    reg.publish("c", "v1", srv)
+    tr = ReqTrace(mode="on", obs=o)
+    with RequestScheduler(reg, "c", max_batch=16, max_wait_us=1000.0,
+                          obs=o, trace=tr) as sched:
+        tickets = [sched.submit(th)
+                   for th in rng.uniform(0, 1, size=(120, 2))]
+        for t in tickets:
+            assert t.result(30.0)[0].ok
+    _assert_phases_sum_to_wall(_phase_hists(o, "c"), 120)
+    # queue_frac gauge minted and sane.
+    qf = o.metrics.snapshot()["gauges"]["serve.ctl.c.queue_frac"]
+    assert 0.0 <= qf <= 1.0
+    assert tr.queue_frac("c") == pytest.approx(qf)
+
+
+def test_arena_scheduler_phase_sum_equals_wall(rng):
+    o = obs_lib.Obs("jsonl")
+    arena = DeviceArena(p=2, n_u=2, capacity_cols=256, obs=o)
+    arena.publish("a", "v1", _synthetic_table(rng), *_BOX)
+    arena.publish("b", "v1", _synthetic_table(rng), *_BOX)
+    fb = FallbackPolicy(*_BOX, obs=o)
+    tr = ReqTrace(mode="on", obs=o)
+    with ArenaScheduler(arena, max_batch=16, max_wait_us=2000.0,
+                        fallback=fb, obs=o, trace=tr) as sched:
+        names = ["a", "b"]
+        tickets = [sched.submit(names[i % 2], th) for i, th
+                   in enumerate(rng.uniform(0, 8, size=(60, 2)))]
+        for t in tickets:
+            t.result(30.0)
+    for ctl in ("a", "b"):
+        _assert_phases_sum_to_wall(_phase_hists(o, ctl), 30)
+
+
+# -- exemplar ring ----------------------------------------------------------
+
+
+def test_exemplar_ring_race_across_hot_swap(rng):
+    """Six concurrent submitters racing a registry hot swap: every
+    exemplar must bind to a COMMITTED version (v1 or v2, never a torn
+    or in-flight label), carry a monotone stamp vector, and the ring
+    must stay bounded at K."""
+    o = obs_lib.Obs("jsonl")
+    reg = ControllerRegistry(obs=o)
+    reg.publish("c", "v1", _server(obs=o))
+    tr = ReqTrace(mode="on", exemplar_k=8, obs=o)
+    stop = threading.Event()
+    errors: list = []
+
+    with RequestScheduler(reg, "c", max_batch=16, max_wait_us=500.0,
+                          obs=o, trace=tr) as sched:
+
+        def submitter(seed):
+            r = np.random.default_rng(seed)
+            try:
+                while not stop.is_set():
+                    sched.submit(r.uniform(0, 1, 2)).result(30.0)
+            except Exception as e:  # pragma: no cover - fail loud
+                errors.append(repr(e))
+
+        threads = [threading.Thread(target=submitter, args=(i,))
+                   for i in range(6)]
+        for t in threads:
+            t.start()
+        time.sleep(0.25)
+        reg.publish("c", "v2", _server(obs=o, scale=2.0))
+        time.sleep(0.25)
+        stop.set()
+        for t in threads:
+            t.join()
+        ex = tr.exemplars("c")
+
+    assert not errors
+    assert 1 <= len(ex) <= 8
+    # Slowest-first ordering.
+    walls = [e["wall_us"] for e in ex]
+    assert walls == sorted(walls, reverse=True)
+    for e in ex:
+        assert e["version"] in ("v1", "v2")
+        st = e["stamps_us"]
+        vals = [st[k] for k in _STAMP_ORDER]
+        assert all(b >= a - 1e-6 for a, b in zip(vals, vals[1:]))
+        assert st["reply"] == pytest.approx(e["wall_us"], abs=1e-3)
+        assert e["rows"] >= 1 and 0.0 < e["batch_fill"] <= 1.0
+
+
+def test_ring_keeps_k_slowest_within_window():
+    ring = _Ring(k=3, window_s=10.0)
+    for i, w in enumerate([5.0, 1.0, 9.0, 3.0, 7.0]):
+        ring.offer(float(i), w, {"wall_us": w})
+    assert [e["wall_us"] for e in ring.snapshot()] == [9.0, 7.0, 5.0]
+    # Entries older than the window are evicted on the next offer.
+    ring.offer(100.0, 0.5, {"wall_us": 0.5})
+    assert [e["wall_us"] for e in ring.snapshot()] == [0.5]
+
+
+def test_flush_emits_exemplar_digest_events():
+    o = obs_lib.Obs("jsonl")
+    tr = ReqTrace(mode="on", obs=o)
+    base = time.perf_counter_ns()
+    tr.fold("c", seal=base + 2_000, lease=base + 3_000,
+            eval0=base + 4_000, eval1=base + 6_000, fb_end=base + 6_500,
+            done=base + 9_000, rows=[((base, base + 500), 2, None)],
+            fill=0.5, version="v1", extent=64)
+    tr.flush()
+    evs = [r for r in o.sink.records
+           if r.get("name") == "serve.trace.exemplars"]
+    assert len(evs) == 1
+    assert evs[0]["controller"] == "c" and evs[0]["n"] == 1
+    assert evs[0]["slowest"][0]["version"] == "v1"
+
+
+def test_fold_drops_batch_sealed_before_attach():
+    """A batch collected while tracing was detached has no seal stamp
+    (the serve_bench A/B flips the hub live); fold must drop it rather
+    than emit a garbage decomposition."""
+    o = obs_lib.Obs("jsonl")
+    tr = ReqTrace(mode="on", obs=o)
+    base = time.perf_counter_ns()
+    tr.fold("c", seal=0, lease=base, eval0=base, eval1=base,
+            fb_end=base, done=base, rows=[((base, base), 1, None)],
+            fill=1.0)
+    assert not _phase_hists(o, "c")
+    assert tr.queue_frac("c") is None
+
+
+# -- off mode ---------------------------------------------------------------
+
+
+def test_off_mode_is_byte_for_byte_noop(rng):
+    """mode='off' (and a missing hub) must leave the serve path with
+    zero trace work: the scheduler drops the hub at construction, no
+    ticket carries stamps, and no phase metric is ever minted."""
+    o = obs_lib.Obs("jsonl")
+    reg = ControllerRegistry(obs=o)
+    reg.publish("c", "v1", _server(obs=o))
+    with RequestScheduler(reg, "c", max_batch=8, max_wait_us=500.0,
+                          obs=o, trace=ReqTrace(mode="off")) as sched:
+        assert sched.trace is None  # dropped at construction
+        tickets = [sched.submit(th)
+                   for th in rng.uniform(0, 1, size=(20, 2))]
+        for t in tickets:
+            assert t.result(30.0)[0].ok
+        assert all(t.t_ns is None for t in tickets)
+    snap = o.metrics.snapshot()
+    assert not any(".phase." in k for k in snap["histograms"])
+    assert not any(k.endswith(".queue_frac") for k in snap["gauges"])
+
+
+def test_trace_overhead_ab(rng):
+    """Interleaved off/on windows through one live scheduler; min-p99
+    per arm (minimum is the noise-robust statistic for a lower-bounded
+    latency).  This is the CI backstop at a loose bound -- the strict
+    <=1% gate runs in scripts/serve_bench.py over seconds-long windows
+    (main() exits nonzero past trace_overhead_frac 0.01), where the
+    arms are long enough for 1% to clear scheduler jitter."""
+    o = obs_lib.Obs("jsonl")
+    reg = ControllerRegistry(obs=o)
+    reg.publish("c", "v1", _server(obs=o))
+    tr = ReqTrace(mode="on", obs=o)
+    thetas = rng.uniform(0, 1, size=(50, 2))
+
+    with RequestScheduler(reg, "c", max_batch=16, max_wait_us=1000.0,
+                          obs=o, trace=tr) as sched:
+
+        def window():
+            # Open-loop pacing below capacity, like serve_bench: the
+            # worker folds while idle between arrivals, so the A/B
+            # measures steady-state overhead, not burst serialization.
+            tks = []
+            for th in thetas:
+                tks.append(sched.submit(th))
+                time.sleep(0.0015)
+            return [t.result(30.0)[0].latency_s for t in tks]
+
+        window()  # warm both code paths (bucket compiles)
+        lat_off, lat_on = [], []
+        for _ in range(5):
+            sched.trace = None
+            lat_off.extend(window())
+            sched.trace = tr
+            lat_on.extend(window())
+
+    # Pooled per-arm p99 over the interleaved windows: pooling keeps
+    # the tail statistic out of single-window max territory, and the
+    # interleaving cancels host drift between arms.
+    p_off = float(np.percentile(np.asarray(lat_off) * 1e6, 99))
+    p_on = float(np.percentile(np.asarray(lat_on) * 1e6, 99))
+    overhead = (p_on - p_off) / p_off
+    assert overhead <= 0.15
+
+
+# -- rolling-window max-age cut (satellite: stale p99) ----------------------
+
+
+def test_prune_stale_unit():
+    from explicit_hybrid_mpc_tpu.serve import scheduler as sched_mod
+    from collections import deque
+
+    now = 1000.0
+    old = now - sched_mod._ROLL_MAX_AGE_S - 1.0
+    lat = deque([(old, 9.9), (old, 9.9), (now - 1.0, 0.001)])
+    fb = deque([(old, 1), (now - 1.0, 0)])
+    sched_mod._prune_stale(lat, fb, now)
+    assert list(lat) == [(now - 1.0, 0.001)]
+    assert list(fb) == [(now - 1.0, 0)]
+
+
+def test_stale_window_stops_firing_serve_p99(rng):
+    """Latency samples older than the max-age cut must fall out of the
+    rolling p99 gauge: a burst of old slow requests cannot keep firing
+    ``health.serve_p99_us`` forever, while the SAME samples with fresh
+    timestamps must fire it (the rule still works)."""
+    o = obs_lib.Obs("jsonl")
+    reg = ControllerRegistry(obs=o)
+    reg.publish("c", "v1", _server(obs=o))
+    rules = {"serve_p99_us": 1e6, "min_solves_for_rates": 1.0}
+    with RequestScheduler(reg, "c", max_batch=16, max_wait_us=500.0,
+                          obs=o) as sched:
+        # A stale burst of 5 s latencies, older than _ROLL_MAX_AGE_S.
+        sched._lat_roll.extend([(time.perf_counter() - 120.0, 5.0)] * 200)
+        for t in [sched.submit(th)
+                  for th in rng.uniform(0, 1, size=(30, 2))]:
+            t.result(30.0)
+        p99 = o.metrics.snapshot()["gauges"]["serve.ctl.c.p99_us"]
+        assert p99 < 1e6  # the 5e6 us stale burst was pruned
+        snap = o.metrics.snapshot()
+        mon = HealthMonitor(rules=rules)
+        mon.feed({"kind": "metrics", "counters": snap["counters"],
+                  "gauges": snap["gauges"]})
+        assert not any(e["name"] == "health.serve_p99_us"
+                       for e in mon.events)
+
+        # Control: the same burst with FRESH timestamps dominates the
+        # window and the rule fires.
+        sched._lat_roll.extend([(time.perf_counter(), 5.0)] * 200)
+        for t in [sched.submit(th)
+                  for th in rng.uniform(0, 1, size=(30, 2))]:
+            t.result(30.0)
+        assert o.metrics.snapshot()["gauges"]["serve.ctl.c.p99_us"] > 1e6
+        snap = o.metrics.snapshot()
+        mon = HealthMonitor(rules=rules)
+        mon.feed({"kind": "metrics", "counters": snap["counters"],
+                  "gauges": snap["gauges"]})
+        assert any(e["name"] == "health.serve_p99_us"
+                   for e in mon.events)
+
+
+# -- queue-dominated health rule --------------------------------------------
+
+
+def test_max_queue_frac_rule_fires_volume_gated():
+    gauges = {"serve.ctl.c.queue_frac": 0.62}
+    # Below the volume gate: silent.
+    mon = HealthMonitor(rules={"max_queue_frac": 0.5})
+    mon.feed({"kind": "metrics",
+              "counters": {"serve.ctl.c.requests": 10.0},
+              "gauges": gauges})
+    assert not mon.events
+    # Past the gate: warn, keyed per controller, nonzero exit.
+    mon.feed({"kind": "metrics",
+              "counters": {"serve.ctl.c.requests": 5000.0},
+              "gauges": gauges})
+    evs = [e for e in mon.events if e["name"] == "health.serve_queue"]
+    assert len(evs) == 1
+    assert evs[0]["severity"] == "warn"
+    assert "queue" in evs[0]["msg"]
+    assert mon.exit_code != 0
+    # Default rules keep the rule off (opt-in like serve_p99_us).
+    mon2 = HealthMonitor()
+    mon2.feed({"kind": "metrics",
+               "counters": {"serve.ctl.c.requests": 5000.0},
+               "gauges": gauges})
+    assert not mon2.events
+
+
+def test_queue_frac_rule_end_to_end(rng):
+    """A long batching window on single-row submits is queue-dominated
+    by construction; the gauge the scheduler publishes must trip the
+    rule through a real metrics snapshot."""
+    o = obs_lib.Obs("jsonl")
+    reg = ControllerRegistry(obs=o)
+    reg.publish("c", "v1", _server(obs=o))
+    # Short trace window so the warmup round (whose wall is dominated
+    # by the first-batch JIT compile, ~100x a warm eval) ages OUT of
+    # the queue_frac roll before the measured round.
+    tr = ReqTrace(mode="on", window_s=0.5, obs=o)
+    with RequestScheduler(reg, "c", max_batch=64, max_wait_us=20000.0,
+                          obs=o, trace=tr) as sched:
+        for t in [sched.submit(th)
+                  for th in rng.uniform(0, 1, size=(40, 2))]:
+            t.result(30.0)  # warmup: compiles the bucket
+        time.sleep(0.6)
+        for t in [sched.submit(th)
+                  for th in rng.uniform(0, 1, size=(40, 2))]:
+            t.result(30.0)
+    snap = o.metrics.snapshot()
+    mon = HealthMonitor(rules={"max_queue_frac": 0.2,
+                               "min_solves_for_rates": 1.0})
+    mon.feed({"kind": "metrics", "counters": snap["counters"],
+              "gauges": snap["gauges"]})
+    assert any(e["name"] == "health.serve_queue" for e in mon.events)
+
+
+# -- host forensics ---------------------------------------------------------
+
+
+def test_gc_pause_recorder_captures_forced_collect():
+    o = obs_lib.Obs("jsonl")
+    with GcPauseRecorder(obs=o) as rec:
+        junk = []
+        for _ in range(1000):
+            a, b = [], []
+            a.append(b)
+            b.append(a)
+            junk.append(a)
+        del junk
+        gc.collect()
+    assert rec.pauses and all(p > 0 for p in rec.pauses)
+    assert rec.total_pause_s() == pytest.approx(sum(rec.pauses) / 1e6)
+    evs = [r for r in o.sink.records
+           if r.get("name") == "serve.host.gc_pause_us"]
+    assert evs and evs[-1]["pause_us"] > 0
+    h = o.metrics.snapshot()["histograms"]["serve.host.gc_pause_us"]
+    assert h["count"] == len(rec.pauses)
+    # Stop is idempotent and the hook is really gone.
+    rec.stop()
+    n = len(rec.pauses)
+    gc.collect()
+    assert len(rec.pauses) == n
+
+
+def test_note_stall_histogram_always_event_rate_limited():
+    class _Clock:
+        t = 0.0
+
+        def __call__(self):
+            return self.t
+
+    clk = _Clock()
+    o = obs_lib.Obs("jsonl")
+    tr = ReqTrace(mode="on", obs=o, clock=clk)
+
+    def stall_events():
+        return [r for r in o.sink.records
+                if r.get("name") == "serve.host.stall_us"]
+
+    tr.note_stall(500_000)  # 500 us: below the event floor
+    assert not stall_events()
+    tr.note_stall(2_000_000)  # 2 ms: evented
+    assert len(stall_events()) == 1
+    tr.note_stall(3_000_000)  # same second: rate-limited
+    assert len(stall_events()) == 1
+    clk.t += 1.5
+    tr.note_stall(3_000_000)
+    assert len(stall_events()) == 2
+    h = o.metrics.snapshot()["histograms"]["serve.host.stall_us"]
+    assert h["count"] == 4  # the histogram always observes
+
+
+# -- config plumbing --------------------------------------------------------
+
+
+def test_trace_from_serve_config():
+    assert trace_from_serve_config(ServeConfig()) is None
+    tr = trace_from_serve_config(
+        ServeConfig(tracing="on", trace_exemplar_k=4, trace_window_s=5.0))
+    assert tr is not None and tr.enabled
+    assert tr.exemplar_k == 4 and tr.window_s == 5.0
+
+    class _Legacy:  # config pickled before the knobs existed
+        pass
+
+    assert trace_from_serve_config(_Legacy()) is None
+
+
+def test_trace_validation():
+    with pytest.raises(ValueError, match="tracing mode"):
+        ReqTrace(mode="sometimes")
+    with pytest.raises(ValueError, match="exemplar_k"):
+        ReqTrace(mode="on", exemplar_k=0)
+    with pytest.raises(ValueError, match="window_s"):
+        ReqTrace(mode="on", window_s=0.0)
+    with pytest.raises(ValueError, match="tracing mode"):
+        ServeConfig(tracing="verbose")
+    with pytest.raises(ValueError, match="trace_exemplar_k"):
+        ServeConfig(tracing="on", trace_exemplar_k=0)
+    with pytest.raises(ValueError, match="trace_window_s"):
+        ServeConfig(tracing="on", trace_window_s=-1.0)

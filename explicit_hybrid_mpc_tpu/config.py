@@ -492,6 +492,23 @@ class ServeConfig:
     # Rolling window (seconds) behind the exemplar ring and the
     # queue_frac gauge.
     trace_window_s: float = 30.0
+    # SLO engine (obs/slo.py): 'on' attaches an SloTracker to the
+    # scheduler -- durable per-controller error budgets with
+    # multi-window burn-rate alerting, ticked at the metrics-flush
+    # cadence (never on the request hot path); 'off' is a no-op.
+    slo: str = "off"
+    # Error-budget compliance goal for the auto-registered serve
+    # objectives (0.999 = 99.9% of requests good).
+    slo_goal: float = 0.999
+    # Good/bad boundary for the p99 objectives (microseconds of
+    # request wall).
+    slo_p99_target_us: float = 50_000.0
+    # Retention-ring slot width (seconds); burn windows are the
+    # obs/slo.py defaults (fast 5m/1h, slow 6h/3d).
+    slo_interval_s: float = 60.0
+    # Durable budget state directory (None = in-memory only; budgets
+    # then do NOT survive restarts).
+    slo_dir: Optional[str] = None
 
     def __post_init__(self) -> None:
         if not is_pow2(self.max_batch):
@@ -545,3 +562,12 @@ class ServeConfig:
             raise ValueError("trace_exemplar_k must be >= 1")
         if self.trace_window_s <= 0:
             raise ValueError("trace_window_s must be > 0")
+        if self.slo not in ("off", "on"):
+            raise ValueError(f"unknown slo mode {self.slo!r} "
+                             "(expected 'off' or 'on')")
+        if not 0.0 < self.slo_goal < 1.0:
+            raise ValueError("slo_goal must be in (0, 1)")
+        if self.slo_p99_target_us <= 0:
+            raise ValueError("slo_p99_target_us must be > 0")
+        if self.slo_interval_s <= 0:
+            raise ValueError("slo_interval_s must be > 0")

@@ -103,6 +103,17 @@ class LifecycleConfig:
     #: leaves re-certify first.  Best-effort: a missing/torn/stale
     #: snapshot degrades to the default node ordering.
     demand_dir: Optional[str] = None
+    #: Attach an SloTracker (obs/slo.py) over the lifecycle metric
+    #: family: per-generation SLA-miss ratio + rolling staleness p99
+    #: as durable error budgets, ticked from the watch loop.  Needs
+    #: an enabled obs handle to do anything.
+    slo: bool = False
+    #: Compliance goal for the lifecycle objectives.
+    slo_goal: float = 0.999
+    #: Retention-ring slot width (seconds) for the lifecycle budgets.
+    slo_interval_s: float = 60.0
+    #: Durable budget state directory (None = in-memory only).
+    slo_dir: Optional[str] = None
 
     def __post_init__(self) -> None:
         if self.poll_s <= 0:
@@ -112,6 +123,10 @@ class LifecycleConfig:
         if self.full_every < 0:
             raise ValueError("full_every must be >= 0 (0 = delta "
                              "whenever a base exists)")
+        if not 0.0 < self.slo_goal < 1.0:
+            raise ValueError("slo_goal must be in (0, 1)")
+        if self.slo_interval_s <= 0:
+            raise ValueError("slo_interval_s must be > 0")
 
 
 class _ControllerState:
@@ -193,6 +208,29 @@ class RebuildService:
                 "ledger": m.gauge("lifecycle.excl_events"),
                 "depth": m.gauge("lifecycle.queue_depth"),
             }
+        # Durable staleness error budget (obs/slo.py), ticked from the
+        # watch loop at a bounded cadence; None when off -- the hub
+        # pattern the schedulers use.
+        self.slo = None
+        if self.cfg.slo and self.obs.enabled:
+            from explicit_hybrid_mpc_tpu.obs import slo as slo_mod
+
+            self.slo = slo_mod.SloTracker(
+                slo_mod.lifecycle_slo_specs(self.cfg.sla_s,
+                                            goal=self.cfg.slo_goal),
+                interval_s=self.cfg.slo_interval_s, obs=self.obs,
+                state_dir=self.cfg.slo_dir, identity="lifecycle")
+        # Host forensics (obs/reqtrace.py), previously serve/bench
+        # only: GC pauses and watcher sleep overshoot are attributed
+        # to the HOST, so a GC-stalled rebuild worker stops blaming
+        # the rebuild.
+        self._gc_rec = None
+        self._host_trace = None
+        if self.obs.enabled:
+            from explicit_hybrid_mpc_tpu.obs import reqtrace as rt_mod
+
+            self._gc_rec = rt_mod.GcPauseRecorder(obs=self.obs)
+            self._host_trace = rt_mod.ReqTrace(mode="on", obs=self.obs)
         # Inherit an env/cfg fault plan exactly like the frontier
         # engine does (the chaos surface for subprocess daemons).
         faults_inj.install_from_config(build_cfg, obs=self.obs)
@@ -213,6 +251,8 @@ class RebuildService:
         if self._started:
             return self
         self._started = True
+        if self._gc_rec is not None:
+            self._gc_rec.start()
         self._watcher.start()
         for w in self._workers:
             w.start()
@@ -228,8 +268,17 @@ class RebuildService:
             for w in self._workers:
                 w.join(timeout)
         self.source.close()
+        if self._gc_rec is not None:
+            self._gc_rec.stop()
         if self.obs.enabled:
-            self.obs.flush_metrics()
+            rec = self.obs.flush_metrics()
+            # Final budget fold + durable commit before the stream
+            # closes, so a supervised restart resumes the budget the
+            # daemon actually earned.
+            if self.slo is not None and rec is not None:
+                self.slo.tick(rec)
+        if self.slo is not None:
+            self.slo.flush()
 
     def __enter__(self) -> "RebuildService":
         return self.start()
@@ -286,7 +335,13 @@ class RebuildService:
 
     # -- watcher: source -> coalesced queue --------------------------------
 
+    #: Watch-loop slo tick cadence (wall seconds): the budget fold is
+    #: per-interval anyway, so ticking every poll (20 Hz default)
+    #: would only burn snapshot walks.
+    _SLO_TICK_S = 2.0
+
     def _watch_loop(self) -> None:
+        last_tick = time.perf_counter()
         while True:
             with self._lock:
                 if self._closed:
@@ -298,7 +353,23 @@ class RebuildService:
                 self._count_failure(None, f"source poll failed: {e}")
             for rev in revs:
                 self._enqueue(rev)
+            if self.slo is not None:
+                now = time.perf_counter()
+                if now - last_tick >= self._SLO_TICK_S:
+                    last_tick = now
+                    rec = self.obs.flush_metrics()
+                    if rec is not None:
+                        self.slo.tick(rec)
+            t_sleep = time.perf_counter()
             time.sleep(self.cfg.poll_s)
+            if self._host_trace is not None:
+                # Sleep-overshoot stall probe (the scheduler flush-loop
+                # idiom): waking far past poll_s is host interference
+                # -- GC, preemption -- not rebuild work, and it lands
+                # in serve.host.stall_us instead of the staleness row.
+                over = time.perf_counter() - t_sleep - self.cfg.poll_s
+                if over > 0:
+                    self._host_trace.note_stall(int(over * 1e9))
 
     def _enqueue(self, rev: Revision) -> None:
         with self._cond:
@@ -595,7 +666,9 @@ class RebuildService:
         deltas = [g for g in gens if g.get("published") == "delta"]
         dfracs = [g["delta_bytes"] / g["full_bytes"] for g in deltas
                   if g.get("delta_bytes") and g.get("full_bytes")]
+        slo = self.slo.summary() if self.slo is not None else None
         return {
+            "slo": slo,
             "generations": len(gens),
             "failures": failures,
             "staleness_p50_s": (round(float(np.percentile(stale, 50)), 3)

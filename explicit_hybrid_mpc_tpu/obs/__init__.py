@@ -47,6 +47,9 @@ from explicit_hybrid_mpc_tpu.obs.recorder import (  # noqa: F401
     BUNDLE_VERSION, FlightRecorder, load_bundle)
 from explicit_hybrid_mpc_tpu.obs.sink import (  # noqa: F401
     SCHEMA_VERSION, JsonlSink, json_default, load_jsonl)
+from explicit_hybrid_mpc_tpu.obs.slo import (  # noqa: F401
+    SloSpec, SloTracker, build_slo_specs, lifecycle_slo_specs,
+    serve_slo_specs, slo_from_serve_config)
 from explicit_hybrid_mpc_tpu.obs.trace import Tracer  # noqa: F401
 
 MODES = ("off", "jsonl", "full")

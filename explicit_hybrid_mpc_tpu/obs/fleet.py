@@ -336,6 +336,86 @@ def fleet_rollup(streams: list[StreamInfo]) -> dict:
     return out
 
 
+def slo_rollup(streams: list[StreamInfo]) -> dict:
+    """Fleet-wide error-budget fold over the shards' FINAL snapshots.
+
+    ``obs/slo.py`` publishes each spec's unit tallies as lifetime
+    COUNTERS (``slo.<spec>.good_units`` / ``.bad_units``) precisely so
+    this fold can reuse the exact counter-sum contract of
+    ``fleet_rollup``: fleet compliance is recomputed from the summed
+    unit totals, never averaged from per-shard compliance gauges
+    (shards with unequal traffic would skew a gauge average).  Gauges
+    stay per-shard except the burn multipliers, where the fleet-worst
+    (max) is reported -- a single shard burning its budget is a fleet
+    problem.  Goal is taken from the gauges and must agree across
+    shards; a mismatch is reported, not folded."""
+    good: dict[str, float] = {}
+    bad: dict[str, float] = {}
+    goals: dict[str, set] = {}
+    burn_fast: dict[str, float] = {}
+    burn_slow: dict[str, float] = {}
+    budget_min: dict[str, float] = {}
+    per_shard: dict[str, dict] = {}
+    for s in streams:
+        snap = _last_snapshot(s.records) or {}
+        counters = snap.get("counters", {}) or {}
+        gauges = snap.get("gauges", {}) or {}
+        row: dict[str, dict] = {}
+        for k, v in counters.items():
+            if not k.startswith("slo.") or not k.endswith("_units"):
+                continue
+            spec, field = k[4:].rsplit(".", 1)
+            if field == "good_units":
+                good[spec] = good.get(spec, 0) + v
+            elif field == "bad_units":
+                bad[spec] = bad.get(spec, 0) + v
+            else:
+                continue
+            row.setdefault(spec, {})[field] = v
+        for k, v in gauges.items():
+            if not k.startswith("slo."):
+                continue
+            spec, field = k[4:].rsplit(".", 1)
+            if field == "goal":
+                goals.setdefault(spec, set()).add(v)
+            elif field == "burn_fast":
+                burn_fast[spec] = max(burn_fast.get(spec, 0.0), v)
+            elif field == "burn_slow":
+                burn_slow[spec] = max(burn_slow.get(spec, 0.0), v)
+            elif field == "budget_remaining_frac":
+                budget_min[spec] = min(budget_min.get(spec, v), v)
+            row.setdefault(spec, {})[field] = v
+        if row:
+            per_shard[s.shard] = row
+    specs: dict[str, dict] = {}
+    notes: list[str] = []
+    for spec in sorted(set(good) | set(bad)):
+        g, b = good.get(spec, 0), bad.get(spec, 0)
+        total = g + b
+        gset = goals.get(spec, set())
+        if len(gset) > 1:
+            notes.append(f"{spec}: goal differs across shards "
+                         f"{sorted(gset)}: budget fold skipped")
+            continue
+        goal = next(iter(gset)) if gset else None
+        entry = {"good": g, "bad": b,
+                 "compliance": (g / total) if total else 1.0,
+                 "goal": goal,
+                 "burn_fast_max": burn_fast.get(spec),
+                 "burn_slow_max": burn_slow.get(spec),
+                 "budget_remaining_frac_min": budget_min.get(spec)}
+        if goal is not None and 0 < goal < 1:
+            allowed = (1.0 - goal) * total
+            entry["budget_remaining_frac"] = (
+                1.0 - b / allowed if allowed > 0 else 1.0)
+        specs[spec] = entry
+    out = {"n_streams": len(streams), "specs": specs,
+           "per_shard": per_shard}
+    if notes:
+        out["notes"] = notes
+    return out
+
+
 # -- straggler / imbalance attribution -------------------------------------
 
 def straggler_report(streams: list[StreamInfo]) -> dict:

@@ -106,6 +106,18 @@ Rule schema (all values floats; 0 disables a threshold rule):
                            build is surviving by GIVING UP on cells
                            at scale -- solver infrastructure is
                            broken, not one poison cell
+``slo_burn_fast``          error-budget burn-rate ceiling on the FAST
+                           window pair (obs/slo.py ``slo.<spec>.
+                           burn_fast`` gauges, published as the MIN
+                           across the pair's 5m/1h windows) ->
+                           ``health.slo_burn`` (critical): the budget
+                           is burning fast enough to exhaust a 3-day
+                           allowance in hours; 0 = off
+``slo_burn_slow``          same over the SLOW 6h/3d pair
+                           (``burn_slow`` gauges) ->
+                           ``health.slo_burn`` (warn): a sustained
+                           on-or-over-budget burn -- ticket, don't
+                           page; 0 = off
 ``max_shard_straggle_frac``  FLEET rule (obs/fleet.py FleetMonitor;
                            scripts/obs_watch.py --fleet): concurrent
                            shards' regions/s spread, 1 - slowest /
@@ -159,6 +171,14 @@ DEFAULT_RULES: dict[str, float] = {
     "min_rebuild_leaves": 500.0,
     "max_staleness_s": 0.0,
     "max_quarantine_frac": 0.02,
+    # SLO burn-rate ceilings (obs/slo.py): the tracker publishes each
+    # pair's burn gauge as the MIN across its two windows, so one
+    # gauge compare here IS the both-windows alert condition.  The
+    # tracker also emits its own rising-edge health.slo_burn events,
+    # which any monitor ADOPTS; these rules are the external-tailer
+    # (obs_watch) complement re-deriving the verdict from gauges.
+    "slo_burn_fast": 14.4,
+    "slo_burn_slow": 1.0,
     # Fleet-level rules (obs/fleet.py FleetMonitor; single-stream
     # monitors carry but never evaluate them, so one validated rule
     # vocabulary covers obs_watch with and without --fleet).
@@ -169,6 +189,73 @@ DEFAULT_RULES: dict[str, float] = {
 }
 
 _SEVERITY = {"ok": 0, "warn": 1, "critical": 2}
+
+#: {rule: (severity-or-'config', one-line doc)} -- the discovery
+#: catalog behind ``obs_watch --list-rules`` (mirroring tpulint's
+#: --list-rules).  'config' marks knobs that gate/shape other rules
+#: rather than firing themselves.  Kept next to DEFAULT_RULES so a new
+#: rule without a catalog row fails the covering test, not discovery.
+RULE_DOCS: dict[str, tuple[str, str]] = {
+    "stall_s": ("critical", "no new obs record for this many wall "
+                            "seconds (health.stall)"),
+    "window_steps": ("config", "build.step window behind the rolling "
+                               "throughput rate"),
+    "min_regions_per_s": ("warn", "rolling regions/s floor "
+                                  "(health.throughput_low); 0 = off"),
+    "max_rescue_frac": ("critical", "rescue share of point solves per "
+                                    "snapshot delta "
+                                    "(health.rescue_storm)"),
+    "max_phase2_survivor_frac": ("critical", "two-phase survivor gauge "
+                                             "ceiling "
+                                             "(health.divergence_storm)"),
+    "min_warmstart_accept": ("warn", "tree warm-start accept-rate "
+                                     "floor "
+                                     "(health.warmstart_collapse)"),
+    "max_shard_imbalance": ("warn", "serving shard max/mean load "
+                                    "ceiling (health.shard_imbalance)"),
+    "max_competing_cpu_frac": ("warn", "competing host CPU share "
+                                       "ceiling "
+                                       "(health.host_contended)"),
+    "max_device_failures": ("warn", "device failures tolerated before "
+                                    "health.device_failures"),
+    "serve_p99_us": ("warn", "per-controller rolling p99 ceiling in "
+                             "us (health.serve_p99_us); 0 = off"),
+    "fallback_frac": ("warn", "per-controller degraded-serve fraction "
+                              "ceiling (health.fallback_frac)"),
+    "max_queue_frac": ("warn", "queue share of request wall ceiling "
+                               "(health.serve_queue); 0 = off"),
+    "max_subopt": ("warn", "measured serving subopt p99 ceiling vs "
+                           "the eps certificate (health.subopt); "
+                           "0 = off"),
+    "min_subopt_samples": ("config", "sample-volume floor for "
+                                     "max_subopt"),
+    "min_rebuild_reuse": ("warn", "warm-rebuild reuse_frac floor "
+                                  "(health.rebuild_reuse_collapse); "
+                                  "0 = off"),
+    "min_rebuild_leaves": ("config", "prior-leaf volume floor for "
+                                     "min_rebuild_reuse"),
+    "max_staleness_s": ("warn", "lifecycle staleness p99 ceiling in "
+                                "wall seconds (health.staleness); "
+                                "0 = off"),
+    "max_quarantine_frac": ("critical", "quarantined share of all "
+                                        "solved cells "
+                                        "(health.quarantine)"),
+    "slo_burn_fast": ("critical", "error-budget burn multiplier "
+                                  "ceiling, fast 5m/1h pair "
+                                  "(health.slo_burn); 0 = off"),
+    "slo_burn_slow": ("warn", "error-budget burn multiplier ceiling, "
+                              "slow 6h/3d pair (health.slo_burn); "
+                              "0 = off"),
+    "max_shard_straggle_frac": ("warn", "fleet regions/s spread "
+                                        "ceiling "
+                                        "(health.shard_straggle)"),
+    "fleet_stall": ("critical", "every fleet shard silent for this "
+                                "many seconds (health.fleet_stall)"),
+    "min_solves_for_rates": ("config", "volume floor shared by the "
+                                       "rate rules"),
+    "metrics_every_steps": ("config", "engine-side monitor feed "
+                                      "cadence in steps"),
+}
 
 
 def rules_from_pairs(pairs: Iterable[tuple[str, float]] | dict
@@ -445,6 +532,34 @@ class HealthMonitor:
                            "answers exceed the eps certificate -- "
                            "check artifact provenance / trigger a "
                            "rebuild", key=f"subopt:{ctl}")
+
+        # SLO burn rate (obs/slo.py): the tracker publishes
+        # slo.<spec>.burn_fast / .burn_slow as the MIN across each
+        # pair's two windows, so a single gauge compare IS the
+        # both-windows multi-burn-rate condition.  No volume gate:
+        # burn is 0.0 by construction until a window holds units.
+        for key, rule, sev in (("burn_fast", "slo_burn_fast",
+                                "critical"),
+                               ("burn_slow", "slo_burn_slow", "warn")):
+            lim = self.rules[rule]
+            if lim <= 0:
+                continue
+            suffix = f".{key}"
+            for gname, v in gauges.items():
+                if not (gname.startswith("slo.")
+                        and gname.endswith(suffix)):
+                    continue
+                if v is None or v <= lim:
+                    continue
+                spec = gname[len("slo."):-len(suffix)]
+                pair = "fast" if key == "burn_fast" else "slow"
+                self._fire(
+                    "slo_burn", sev, round(v, 3), lim,
+                    f"slo {spec!r} burning {v:.1f}x its budget rate "
+                    f"on both {pair}-pair windows (> {lim:g}x): see "
+                    "the budget-exhaustion runbook in "
+                    "docs/observability.md",
+                    key=f"{rule}:{spec}")
 
         # Warm-rebuild reuse collapse: a near-zero reuse fraction on a
         # LARGE prior tree means the revision invalidated (almost)

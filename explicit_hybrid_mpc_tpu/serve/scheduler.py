@@ -200,7 +200,7 @@ class RequestScheduler:
     def __init__(self, registry, controller: str,
                  max_batch: int = 256, max_wait_us: float = 2000.0,
                  fallback=None, obs: "obs_lib.Obs | None" = None,
-                 demand=None, trace=None):
+                 demand=None, trace=None, slo=None):
         if not config_mod.is_pow2(max_batch):
             raise ValueError(f"max_batch must be a power of two, "
                              f"got {max_batch}")
@@ -219,6 +219,10 @@ class RequestScheduler:
         # off-mode contract as demand.
         self.trace = trace if trace is not None \
             and getattr(trace, "enabled", False) else None
+        # SLO tracker (obs/slo.py SloTracker) or None; ticked only at
+        # the metrics-flush cadence, never per request.
+        self.slo = slo if slo is not None \
+            and getattr(slo, "enabled", False) else None
         self._t_seal_ns = 0
         self._stall_over_ns = 0
         self._obs = obs if obs is not None else obs_lib.NOOP
@@ -246,6 +250,11 @@ class RequestScheduler:
                 "fb_frac": m.gauge(f"{ns}.fallback_frac"),
                 "requests": m.counter(f"{ns}.requests"),
                 "batches": m.counter(f"{ns}.batches"),
+                # Cumulative degraded-request count (any fallback
+                # tag): with .requests it gives the fallback SLO a
+                # counter-delta denominator, where the rolling
+                # fb_frac gauge forgets history.
+                "fallbacks": m.counter(f"{ns}.fallbacks"),
                 # Cross-controller aggregates, incremented under
                 # _AGG_LOCK (obs Counters are single-producer by
                 # contract and these two names are shared; gauges
@@ -386,7 +395,11 @@ class RequestScheduler:
                     self._last_flush = now
                     if self.trace is not None:
                         self.trace.flush()
-                    self._obs.flush_metrics()
+                    rec = self._obs.flush_metrics()
+                    # Budget fold reuses the snapshot just emitted --
+                    # one registry walk per flush, not two.
+                    if self.slo is not None and rec is not None:
+                        self.slo.tick(rec)
 
     def _serve(self, entries) -> None:
         thetas = np.concatenate([rows for _t, _o, rows in entries])
@@ -434,6 +447,9 @@ class RequestScheduler:
             self._ms["batch_fill"].observe(fill)
             self._ms["fill"].set(
                 sum(self._fill_roll) / len(self._fill_roll))
+            n_fb = sum(1 for t in tags if t is not None)
+            if n_fb:
+                self._ms["fallbacks"].inc(n_fb)
         trace_rows = [] if tr is not None else None
         lo = 0
         for ticket, off, rows in entries:
@@ -504,6 +520,8 @@ class RequestScheduler:
             self._closed = True
             self._cond.notify_all()
         self._worker.join(timeout)
+        if self.slo is not None:
+            self.slo.flush()
 
     def __enter__(self) -> "RequestScheduler":
         return self
@@ -557,7 +575,7 @@ class ArenaScheduler:
     def __init__(self, arena, max_batch: int = 256,
                  max_wait_us: float = 2000.0, fallback=None,
                  obs: "obs_lib.Obs | None" = None, demand=None,
-                 trace=None):
+                 trace=None, slo=None):
         if not config_mod.is_pow2(max_batch):
             raise ValueError(f"max_batch must be a power of two, "
                              f"got {max_batch}")
@@ -571,6 +589,10 @@ class ArenaScheduler:
             and getattr(demand, "enabled", False) else None
         self.trace = trace if trace is not None \
             and getattr(trace, "enabled", False) else None
+        # SLO tracker (obs/slo.py); a serve_template tracker discovers
+        # tenants from the per-controller counters as they appear.
+        self.slo = slo if slo is not None \
+            and getattr(slo, "enabled", False) else None
         self._t_seal_ns = 0
         self._stall_over_ns = 0
         self._obs = obs if obs is not None else obs_lib.NOOP
@@ -620,7 +642,8 @@ class ArenaScheduler:
             m = self._obs.metrics
             ns = f"serve.ctl.{name}"
             ms = {"requests": m.counter(f"{ns}.requests"),
-                  "outside_box": m.counter(f"{ns}.fallback.outside_box")}
+                  "outside_box": m.counter(f"{ns}.fallback.outside_box"),
+                  "fallbacks": m.counter(f"{ns}.fallbacks")}
             self._ctl_ms[name] = ms
         return ms
 
@@ -722,7 +745,9 @@ class ArenaScheduler:
                     self._last_flush = now
                     if self.trace is not None:
                         self.trace.flush()
-                    self._obs.flush_metrics()
+                    rec = self._obs.flush_metrics()
+                    if self.slo is not None and rec is not None:
+                        self.slo.tick(rec)
 
     def _serve(self, entries) -> None:
         thetas = np.concatenate([rows for _t, _o, _n, rows in entries])
@@ -787,6 +812,9 @@ class ArenaScheduler:
                 n_out = int(np.sum(res.clamped[lo:lo + k]))
                 if n_out:
                     cms["outside_box"].inc(n_out)
+                n_fb = sum(1 for t in tags[lo:lo + k] if t is not None)
+                if n_fb:
+                    cms["fallbacks"].inc(n_fb)
             self._lat_roll.extend([(now, lat)] * k)
             self._fb_roll.extend(
                 [(now, 0 if t is None else 1)
@@ -848,6 +876,8 @@ class ArenaScheduler:
             self._closed = True
             self._cond.notify_all()
         self._worker.join(timeout)
+        if self.slo is not None:
+            self.slo.flush()
 
     def __enter__(self) -> "ArenaScheduler":
         return self

@@ -162,6 +162,14 @@ GATED_METRICS: dict[str, tuple] = {
     # and noisy on the contended CI host, so it gets a wide relative
     # band plus an absolute slack.
     "serve_queue_frac": ("lower", 0.25, 0.10),
+    # Error-budget compliance (scripts/serve_bench.py + obs/slo.py,
+    # ISSUE 20): worst-spec good-unit fraction over the sweep's budget
+    # rings.  Higher is better; the figure lives in [0, 1] and sits
+    # near 1 on a healthy capture, so the relative band is narrow and
+    # the absolute slack carries the real tolerance (a 0.02 compliance
+    # drop at goal 0.999 is ~20x the budgeted error rate -- anything
+    # past the slack is a genuine burn, not noise).
+    "slo_compliance": ("higher", 0.05, 0.02),
 }
 
 _ROW_EXTRAS = ("regions", "unit", "precision", "truncated",
@@ -238,6 +246,15 @@ _ROW_EXTRAS = ("regions", "unit", "precision", "truncated",
                "trace_overhead_frac", "serve_p99_trace_off_us",
                "serve_p99_trace_on_us",
                "gc_pause_frac", "gc_pauses", "gc_disabled",
+               # Error-budget rows (serve_bench.py + obs/slo.py, ISSUE
+               # 20): remaining budget fraction, max fast-pair burn
+               # multiplier, and the per-request tracking cost ride
+               # next to the gated slo_compliance (informational --
+               # serve_bench's own exit bar enforces the <=1% overhead
+               # budget at capture time; drift_smoke rows carry the
+               # lifecycle figures).
+               "slo_budget_remaining_frac", "slo_burn_fast_max",
+               "slo_overhead_frac",
                # Certificate-margin telemetry (partition/certify.py
                # cert_margin -> build.cert_margin histogram; bench.py
                # rows): the 1st-percentile eps-suboptimality slack

@@ -29,7 +29,13 @@ unless:
   into the scheduler) and on exit the ``serve.ctl.di.phase.*_us``
   histograms exist and their per-phase means sum to the traced
   request wall within 2% -- the phase-sum==wall invariant surviving
-  live hot swaps.
+  live hot swaps;
+- error budgets fold on both sides of the swap (obs/slo.py, ISSUE
+  20): the serve-side SloTracker must auto-discover the ``di`` specs
+  off the scheduler's flush snapshots, and the lifecycle daemon
+  (``LifecycleConfig.slo``) must report its own staleness-budget
+  summary -- the verdict carries ``slo_compliance`` /
+  ``slo_burn_fast_max`` and the daemon's budget table.
 
 Usage (docs/perf.md pre-merge checklist, ~1-2 min CPU)::
 
@@ -113,7 +119,13 @@ def main(argv: list[str] | None = None) -> int:
         source, build_cfg,
         cfg=LifecycleConfig(artifacts_root=os.path.join(wd, "art"),
                             sla_s=args.staleness_budget,
-                            demand_dir=demand_dir),
+                            demand_dir=demand_dir,
+                            # Error-budget accounting (obs/slo.py):
+                            # the daemon tracks its staleness SLO with
+                            # durable state under slo_dir -- the
+                            # persistence path runs in every smoke.
+                            slo=True,
+                            slo_dir=os.path.join(wd, "slo")),
         registry=registry, obs=obs)
     source.gate = (lambda: len(svc.generations) + svc.n_failures
                    >= source.n_emitted)
@@ -135,9 +147,21 @@ def main(argv: list[str] | None = None) -> int:
     from explicit_hybrid_mpc_tpu.obs.reqtrace import ReqTrace
 
     trace = ReqTrace(mode="on", obs=obs)
+    # Serve-side error budgets ride the same load (obs/slo.py): specs
+    # auto-discover for "di" off the scheduler's flush snapshots.
+    # Windows scale with the sub-second interval (one ring slot per
+    # interval across the longest window); the p99 target is generous
+    # for the contended 2-core harness -- the audit below checks the
+    # WIRING (specs discovered, budgets folding), not a latency bar.
+    from explicit_hybrid_mpc_tpu.obs.slo import SloTracker
+
+    slo = SloTracker(interval_s=0.5,
+                     windows=((5.0, 60.0), (120.0, 600.0)), obs=obs,
+                     serve_template={"p99_target_us": 250_000.0,
+                                     "goal": 0.999})
     sched = RequestScheduler(registry, "di", max_batch=32,
                              max_wait_us=2000.0, obs=obs, demand=hub,
-                             trace=trace)
+                             trace=trace, slo=slo)
     served: list[tuple[np.ndarray, object]] = []
     dropped: list[str] = []
     stop = threading.Event()
@@ -164,6 +188,8 @@ def main(argv: list[str] | None = None) -> int:
     stop.set()
     loader.join(30)
     sched.close()
+    if obs.enabled:  # final budget fold: the tail of the last window
+        slo.tick(obs.metrics.snapshot())
     hub.close()  # final committed snapshot under demand_dir/di/
     svc.close()
     obs.close()
@@ -226,6 +252,20 @@ def main(argv: list[str] | None = None) -> int:
                 f"request wall {wall_mean:.1f}us (>2%): a lifecycle "
                 "stamp went missing across the hot swaps")
 
+    # -- error-budget audit: serve specs discovered, daemon tracked --------
+    slo_eval = slo.evaluate()
+    slo_comp = (min(d["compliance"] for d in slo_eval.values())
+                if slo_eval else None)
+    slo_burn = (max(d["burn_fast"] for d in slo_eval.values())
+                if slo_eval else None)
+    if not slo_eval:
+        failures.append("serve SLO tracker discovered no specs under "
+                        "live load (obs/slo.py scheduler wiring)")
+    lc_slo = summary.get("slo")
+    if not lc_slo:
+        failures.append("lifecycle daemon reported no SLO summary "
+                        "(LifecycleConfig.slo wiring)")
+
     # -- torn-swap audit: every result bitwise vs its version's table ------
     by_version: dict[str, list[int]] = {}
     for i, (_th, r) in enumerate(served):
@@ -260,6 +300,9 @@ def main(argv: list[str] | None = None) -> int:
         "versions_served": sorted(by_version),
         "demand_leaves": demand_leaves,
         "trace_phases": sorted(ph),
+        "slo_compliance": slo_comp,
+        "slo_burn_fast_max": slo_burn,
+        "lifecycle_slo": lc_slo,
         "failures": failures,
     }
     if args.json_out:

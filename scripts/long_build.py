@@ -53,6 +53,16 @@ the recorder's before checkpoint-and-halting (obs/profiling.py);
 LONG_OBS_PER_PROCESS=1 -- each resumed session writes its own
 ``.pI-PID`` obs stream instead of appending to one file, merged by
 ``scripts/obs_report.py --fleet``.
+
+Error budgets + host forensics (ISSUE 20): LONG_SLO (default 1 when
+LONG_OBS is on) -- a durable build SLO tracker (obs/slo.py,
+build.quarantine objective) folds every checkpoint's metrics snapshot
+into retention rings persisted next to the checkpoint, so a resumed
+campaign keeps the budget it already burned; a sustained quarantine
+burn emits ``health.slo_burn`` into the obs stream (goal via
+LONG_SLO_GOAL, default 0.999; docs/observability.md has the runbook).
+GC collection pauses (serve.host.gc_pause_us) and capture-pause sleep
+overshoots (serve.host.stall_us) are recorded as host forensics.
 """
 
 from __future__ import annotations
@@ -275,6 +285,37 @@ def run(result: dict, out_path: str) -> None:
                 json.loads(os.environ.get("LONG_HEALTH_RULES", "{}")),
                 sink=(build_obs.sink if build_obs.enabled else None))
 
+        # Host forensics + error budgets (ISSUE 20).  The GC recorder
+        # lands collection pauses in the obs stream next to the waves
+        # they stretched; the ReqTrace hub gives the capture-pause loop
+        # a note_stall sink so oversleeping past the 30 s yield quantum
+        # surfaces as serve.host.stall_us instead of silently widening
+        # paused_s.  LONG_SLO (default on when LONG_OBS is on) runs a
+        # durable build error-budget tracker (build.quarantine,
+        # obs/slo.py) at checkpoint cadence; its retention rings
+        # persist next to the checkpoint, so a resumed campaign keeps
+        # the budget it already burned instead of resetting to a full
+        # budget every session.
+        gc_rec = None
+        host_trace = None
+        slo = None
+        if build_obs.enabled:
+            from explicit_hybrid_mpc_tpu.obs.reqtrace import (
+                GcPauseRecorder, ReqTrace)
+
+            gc_rec = GcPauseRecorder(build_obs).start()
+            host_trace = ReqTrace("on", obs=build_obs)
+            if os.environ.get("LONG_SLO", "1") != "0":
+                from explicit_hybrid_mpc_tpu.obs.slo import (
+                    SloTracker, build_slo_specs)
+
+                slo = SloTracker(
+                    build_slo_specs(float(os.environ.get(
+                        "LONG_SLO_GOAL", "0.999"))),
+                    obs=build_obs,
+                    state_dir=os.path.dirname(ckpt) or ".",
+                    identity="long_build")
+
         last_ckpt_step = eng.steps
         last_dev_failures = eng.n_device_failures
         while eng.frontier:
@@ -295,7 +336,11 @@ def run(result: dict, out_path: str) -> None:
                 if not in_pause:
                     log("capture window active: pausing build")
                     in_pause = True
+                ts = time.monotonic()
                 time.sleep(30)
+                if host_trace is not None:
+                    host_trace.note_stall(max(
+                        0, int((time.monotonic() - ts - 30.0) * 1e9)))
                 paused_s += 30.0
             if in_pause:
                 log("capture window over: resuming build")
@@ -319,6 +364,13 @@ def run(result: dict, out_path: str) -> None:
                 # one end-of-run point.  The snapshot doubles as the
                 # health monitor's rate-rule input.
                 snap_rec = build_obs.flush_metrics()  # None when off
+                if slo is not None and snap_rec is not None:
+                    # Error-budget fold at checkpoint cadence: the
+                    # quarantine counters' delta since the previous
+                    # checkpoint lands in the retention rings; a
+                    # sustained burn emits health.slo_burn into the
+                    # same stream the watchdog below reads.
+                    slo.tick(snap_rec)
                 if health_mon is not None:
                     new_ev = []
                     if snap_rec is not None:
@@ -374,6 +426,16 @@ def run(result: dict, out_path: str) -> None:
         stats = eng.stats_dict(total_wall)
         result["stats"] = stats
         result["paused_for_captures_s"] = round(paused_s, 1)
+        if slo is not None:
+            # Final fold (the tail since the last checkpoint), then
+            # persist the burned budget for the next resumed session.
+            slo.tick(build_obs.metrics.snapshot())
+            result["slo"] = slo.evaluate()
+            slo.flush()
+        if gc_rec is not None:
+            gc_rec.stop()
+            result["gc_collections"] = len(gc_rec.pauses)
+            result["gc_pause_total_s"] = round(gc_rec.total_pause_s(), 3)
         write_out(out_path, result)
         build_obs.event("build.done", **stats)
         log(f"build stopped ({result['stop_reason']}): "

@@ -315,6 +315,26 @@ def report(records: list[dict]) -> dict:
                  if f"serve.host.{h}" in out["histograms"]}
         if hostf:
             out["serve_host"] = hostf
+        # SLO / error-budget accounting (obs/slo.py, ISSUE 20): per-spec
+        # compliance/budget/burn gauges plus lifetime good/bad unit
+        # counters, published under slo.<spec>.<field>.  Field names
+        # carry no dots, so rsplit cleanly peels them off dotted spec
+        # names like "default.p99".
+        slo: dict = {}
+        for key, v in out["gauges"].items():
+            if not key.startswith("slo.") or "." not in key[4:]:
+                continue
+            spec, field = key[4:].rsplit(".", 1)
+            if field in ("goal", "compliance", "budget_remaining_frac",
+                         "burn_fast", "burn_slow"):
+                slo.setdefault(spec, {})[field] = v
+        for key, v in out["counters"].items():
+            if key.startswith("slo.") and key.endswith("_units") \
+                    and "." in key[4:]:
+                spec, field = key[4:].rsplit(".", 1)
+                slo.setdefault(spec, {})[field] = v
+        if slo:
+            out["slo"] = slo
 
     # Exemplar digests ride the bounded serve.trace.exemplars events
     # (obs/reqtrace.py flush); the LAST event per controller wins --
@@ -566,6 +586,22 @@ def diff_bench(rep: dict, bench: dict, tol: float = 0.10) -> list[str]:
                 f"{b_qf:.2f} -- the tail is going queue-dominated; "
                 "scale replicas or raise max_batch "
                 "(docs/observability.md queue_dominated runbook)")
+    # SLO compliance regression (ISSUE 20), compared in BAD-fraction
+    # space: 0.999 vs 0.995 is a 5x error-rate difference a relative
+    # tolerance on the compliance figure itself cannot see.  The +0.005
+    # absolute slack keeps tiny-volume captures (one bad unit in a
+    # short run) from flagging on quantization noise.
+    b_c = bench.get("slo_compliance")
+    if b_c is not None and 0 < b_c <= 1:
+        b_bad = 1.0 - b_c
+        for spec, d in sorted((rep.get("slo") or {}).items()):
+            r_c = d.get("compliance")
+            if r_c is not None and (1.0 - r_c) > (1 + tol) * b_bad + 0.005:
+                flags.append(
+                    f"slo compliance regression [{spec}]: {r_c:.5f} vs "
+                    f"bench {b_c:.5f} (bad fraction {1 - r_c:.4g} vs "
+                    f"{b_bad:.4g}) -- the error budget is burning "
+                    "faster than the gated capture's")
     # Serving headline: sharded us/query against the bench's large-L
     # figure, when both sides measured it.
     b_us = bench.get("large_l_sharded_us_per_query")
@@ -759,6 +795,24 @@ def render_text(rep: dict, flags: list[str], bench_path: str | None) -> str:
                         f"(p99 {_fmt_lat((stl['p99'] or 0) / 1e6)})")
         if bits:
             ln.append("serve host: " + ", ".join(bits))
+    slo = rep.get("slo")
+    if slo:
+        for spec in sorted(slo):
+            d = slo[spec]
+            comp, goal = d.get("compliance"), d.get("goal")
+            budget = d.get("budget_remaining_frac")
+            n = int(d.get("good_units") or 0) \
+                + int(d.get("bad_units") or 0)
+            ln.append(
+                f"slo [{spec}]: compliance "
+                + (f"{comp:.5f}" if comp is not None else "-")
+                + (f" (goal {goal:g})" if goal is not None else "")
+                + (f", budget {100 * budget:.0f}% left"
+                   if budget is not None else "")
+                + f", burn fast/slow {d.get('burn_fast', 0.0):.2f}/"
+                  f"{d.get('burn_slow', 0.0):.2f} over {n} unit(s)"
+                + (" -- BUDGET EXHAUSTED" if budget is not None
+                   and budget <= 0 else ""))
     dem = rep.get("demand")
     if dem:
         for ctl in sorted(dem):
@@ -837,6 +891,10 @@ def fleet_report(streams) -> dict:
                              for sid, row in
                              (roll.get("per_shard") or {}).items()},
             "critical_path": cp,
+            # Fleet error budgets (obs/fleet.py slo_rollup): compliance
+            # recomputed from summed unit counters, never averaged from
+            # per-shard gauges.
+            "slo": fleet_lib.slo_rollup(streams),
             "straggler": fleet_lib.straggler_report(streams),
             "issues": fleet_lib.strict_issues(streams),
             "shards": shards}
@@ -887,6 +945,20 @@ def render_fleet(rep: dict) -> str:
             f"{seg} {100 * cp[seg]:.0f}%"
             for seg in ("fill", "plan", "wait", "certify", "other"))
             + f" (ckpt {cp.get('checkpoint_s', 0.0):.1f}s)")
+    sroll = rep.get("slo") or {}
+    for spec in sorted(sroll.get("specs") or {}):
+        d = sroll["specs"][spec]
+        ln.append(
+            f"slo [{spec}] (fleet): compliance {d['compliance']:.5f}"
+            + (f" (goal {d['goal']:g})"
+               if d.get("goal") is not None else "")
+            + (f", budget {100 * d['budget_remaining_frac']:.0f}% left"
+               if d.get("budget_remaining_frac") is not None else "")
+            + f", worst-shard burn fast/slow "
+              f"{d.get('burn_fast_max') or 0.0:.2f}/"
+              f"{d.get('burn_slow_max') or 0.0:.2f}")
+    for note in sroll.get("notes") or []:
+        ln.append(f"  SLO NOTE: {note}")
     strag = rep.get("straggler", {})
     if strag.get("straggle_frac") is not None:
         ln.append(

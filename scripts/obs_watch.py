@@ -227,7 +227,8 @@ def _parse_rules(pairs: list[str]) -> dict:
 
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("stream", help="obs JSONL stream path")
+    ap.add_argument("stream", nargs="?", default=None,
+                    help="obs JSONL stream path")
     ap.add_argument("--rule", action="append", default=[],
                     metavar="NAME=VALUE",
                     help="override a health rule (repeatable; see "
@@ -246,9 +247,24 @@ def main(argv: list[str] | None = None) -> int:
                          "streams (glob / directory / bare name): "
                          "per-shard rules plus the cross-shard "
                          "straggler and fleet-stall rules")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the health-rule catalog (name, default, "
+                         "severity, one-line doc) and exit")
     ap.add_argument("--json", dest="json_out", default=None,
                     help="write the monitor summary here on exit")
     args = ap.parse_args(argv)
+
+    if args.list_rules:
+        from explicit_hybrid_mpc_tpu.obs.health import (DEFAULT_RULES,
+                                                        RULE_DOCS)
+
+        for name in sorted(DEFAULT_RULES):
+            sev, doc = RULE_DOCS.get(name, ("?", ""))
+            print(f"{name:28s} {DEFAULT_RULES[name]:<10g} "
+                  f"[{sev}] {doc}")
+        return 0
+    if args.stream is None:
+        ap.error("stream argument is required (or use --list-rules)")
 
     rules = _parse_rules(args.rule)
     if args.stall_s is not None:

@@ -1,0 +1,187 @@
+"""Pre-merge preflight: the documented verification battery as ONE
+command with ONE verdict.
+
+The pre-merge checklist (verify SKILL.md, docs/perf.md) has grown to
+six commands spread across as many docs sections: the tier-1 pytest
+run (ROADMAP.md), bench_gate, tpulint, chaos_suite, fleet_smoke, and
+drift_smoke.  Running them by hand means forgetting one; this script
+runs the battery in sequence, times each check, streams each check's
+output to its own log file, and prints a single JSON verdict --
+exit 0 iff every check passed.
+
+Usage::
+
+    python scripts/preflight.py                 # full battery (~15-25 min CPU)
+    python scripts/preflight.py --quick         # eps-relaxed smokes (~8-12 min)
+    python scripts/preflight.py --only tier1,tpulint
+    python scripts/preflight.py --skip chaos_suite
+    python scripts/preflight.py --list          # show the battery
+    python scripts/preflight.py --json -        # verdict JSON to stdout only
+
+Checks (in order -- cheap gates first so a lint finding fails in
+seconds, not after the chaos suite):
+
+- **tpulint**: static TPU-hostility gate (docs/static_analysis.md).
+- **tier1**: the ROADMAP.md tier-1 pytest command (fast-tier suite,
+  forced CPU).
+- **bench_gate**: newest committed BENCH_*.json vs the trailing
+  same-platform history window (docs/perf.md).
+- **chaos_suite**: fault schedules must reproduce the identical
+  certified tree (docs/robustness.md).
+- **fleet_smoke**: per-process obs streams must reconcile bit-exactly
+  with the single-process build (docs/observability.md).
+- **drift_smoke**: 3-revision lifecycle walk under live serving load
+  with SLO trackers on both sides (docs/lifecycle.md).
+
+Verdict JSON: ``{"ok": bool, "wall_s": total, "checks": [{"name",
+"cmd", "exit", "ok", "wall_s", "log"}, ...]}`` -- also written to
+``<out-dir>/preflight.json`` so CI and the next session can read the
+last verdict without re-running the battery.  BENCH_HISTORY is
+cleared for the smoke checks (they build throwaway trees; only
+bench.py's own captures belong in the gate history).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PY = sys.executable
+
+
+def battery(quick: bool) -> list[dict]:
+    """The documented pre-merge checks, cheapest first.  Each entry:
+    name, argv, per-check timeout (generous -- a hung check is a
+    failure, not a wait), env overrides."""
+    eps = ["--eps", "0.5"] if quick else []
+    # Smoke builds must not pollute the bench-gate history (same
+    # contract as tests/conftest.py): BENCH_HISTORY="" disables the
+    # append inside those children only.
+    no_hist = {"BENCH_HISTORY": ""}
+    return [
+        {"name": "tpulint",
+         "argv": [PY, os.path.join("scripts", "tpulint.py")],
+         "timeout": 180, "env": {}},
+        # The ROADMAP.md tier-1 command, minus the tee/grep counting
+        # wrapper (the exit code is the verdict here; the log file
+        # replaces the tee).
+        {"name": "tier1",
+         "argv": [PY, "-m", "pytest", "tests/", "-q", "-m", "not slow",
+                  "--continue-on-collection-errors",
+                  "-p", "no:cacheprovider", "-p", "no:xdist",
+                  "-p", "no:randomly"],
+         "timeout": 900, "env": {"JAX_PLATFORMS": "cpu"}},
+        {"name": "bench_gate",
+         "argv": [PY, os.path.join("scripts", "bench_gate.py")],
+         "timeout": 120, "env": {}},
+        {"name": "chaos_suite",
+         "argv": [PY, os.path.join("scripts", "chaos_suite.py")] + eps,
+         "timeout": 900, "env": dict(no_hist)},
+        {"name": "fleet_smoke",
+         "argv": [PY, os.path.join("scripts", "fleet_smoke.py")] + eps,
+         "timeout": 600, "env": dict(no_hist)},
+        {"name": "drift_smoke",
+         "argv": [PY, os.path.join("scripts", "drift_smoke.py")] + eps,
+         "timeout": 600, "env": dict(no_hist)},
+    ]
+
+
+def run_check(chk: dict, out_dir: str) -> dict:
+    log_path = os.path.join(out_dir, chk["name"] + ".log")
+    env = dict(os.environ)
+    env.update(chk["env"])
+    t0 = time.monotonic()
+    with open(log_path, "wb") as log:
+        try:
+            proc = subprocess.run(chk["argv"], cwd=REPO, env=env,
+                                  stdout=log, stderr=subprocess.STDOUT,
+                                  timeout=chk["timeout"])
+            code: object = proc.returncode
+        except subprocess.TimeoutExpired:
+            code = f"timeout>{chk['timeout']}s"
+    wall = time.monotonic() - t0
+    ok = code == 0
+    return {"name": chk["name"], "cmd": " ".join(chk["argv"]),
+            "exit": code, "ok": ok, "wall_s": round(wall, 1),
+            "log": log_path}
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="run the documented pre-merge battery; one JSON "
+                    "verdict, exit 0 iff all checks pass")
+    ap.add_argument("--quick", action="store_true",
+                    help="pass --eps 0.5 to the smoke checks")
+    ap.add_argument("--only", default=None,
+                    help="comma-separated check names to run")
+    ap.add_argument("--skip", default=None,
+                    help="comma-separated check names to skip")
+    ap.add_argument("--out-dir",
+                    default=os.path.join(REPO, "artifacts", "preflight"),
+                    help="per-check logs + preflight.json land here")
+    ap.add_argument("--json", dest="json_out", default=None,
+                    help="also write the verdict JSON here ('-' = "
+                         "stdout only, no file)")
+    ap.add_argument("--list", action="store_true",
+                    help="print the battery and exit")
+    args = ap.parse_args(argv)
+
+    checks = battery(args.quick)
+    names = {c["name"] for c in checks}
+    for flag in ("only", "skip"):
+        val = getattr(args, flag)
+        if val:
+            unknown = set(val.split(",")) - names
+            if unknown:
+                ap.error(f"--{flag}: unknown check(s) "
+                         f"{sorted(unknown)}; have {sorted(names)}")
+    if args.only:
+        keep = set(args.only.split(","))
+        checks = [c for c in checks if c["name"] in keep]
+    if args.skip:
+        drop = set(args.skip.split(","))
+        checks = [c for c in checks if c["name"] not in drop]
+
+    if args.list:
+        for c in checks:
+            print(f"{c['name']:12s} timeout {c['timeout']:>4d}s  "
+                  f"{' '.join(c['argv'])}")
+        return 0
+
+    os.makedirs(args.out_dir, exist_ok=True)
+    results = []
+    t0 = time.monotonic()
+    for chk in checks:
+        print(f"preflight: {chk['name']} ...", flush=True)
+        res = run_check(chk, args.out_dir)
+        results.append(res)
+        tag = "ok" if res["ok"] else f"FAIL (exit {res['exit']})"
+        print(f"preflight: {chk['name']}: {tag} "
+              f"in {res['wall_s']}s  [{res['log']}]", flush=True)
+
+    verdict = {"ok": all(r["ok"] for r in results),
+               "wall_s": round(time.monotonic() - t0, 1),
+               "quick": args.quick,
+               "checks": results}
+    out = json.dumps(verdict, indent=2)
+    print(out)
+    if args.json_out != "-":
+        path = args.json_out or os.path.join(args.out_dir,
+                                             "preflight.json")
+        with open(path, "w") as f:
+            f.write(out + "\n")
+    if not verdict["ok"]:
+        failed = [r["name"] for r in results if not r["ok"]]
+        print(f"PREFLIGHT FAILED: {', '.join(failed)}", file=sys.stderr)
+        return 1
+    print("PREFLIGHT OK")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

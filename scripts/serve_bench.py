@@ -32,6 +32,17 @@ Env knobs (defaults target the tier-1 CPU config):
     SERVE_BENCH_OUTSIDE_FRAC=0.05 SERVE_BENCH_OUT=...
     SERVE_BENCH_SKEW=0 SERVE_BENCH_DEMAND=on
     SERVE_BENCH_TRACE=on SERVE_BENCH_NO_GC=0
+    SERVE_BENCH_SLO=on SERVE_BENCH_SLO_P99_US=50000
+    SERVE_BENCH_SLO_GOAL=0.999
+
+**Error budgets (ISSUE 20)**: with ``SERVE_BENCH_SLO=on`` (the
+default) both sweep modes attach an obs/slo.py SloTracker to the
+scheduler's metrics-flush path; the BENCH row carries the worst-spec
+``slo_compliance`` / ``slo_budget_remaining_frac``, the max fast-pair
+burn multiplier ``slo_burn_fast_max``, and ``slo_overhead_frac`` --
+the per-request amortized budget-fold cost relative to the measured
+p99, gated <= 1% in main() (bench_gate gates the compliance figure
+against the trailing window).
 
 **Request tracing + host forensics (ISSUE 19)**: with
 ``SERVE_BENCH_TRACE=on`` (the default) both sweep modes run under a
@@ -125,6 +136,51 @@ def _make_trace(o):
 
     return reqtrace.ReqTrace(mode="on", exemplar_k=8, window_s=600.0,
                              obs=o)
+
+
+def _make_slo(o):
+    """SloTracker for the sweep (SERVE_BENCH_SLO=off disables): specs
+    auto-discover per controller via the serve template (obs/slo.py),
+    so the same factory covers the legacy single-controller path and
+    the lazily-minted arena tenants.  The 0.5s interval lets the
+    budget ring actually advance inside a seconds-long sweep."""
+    if str(_env("SERVE_BENCH_SLO", "on", str)) == "off":
+        return None
+    from explicit_hybrid_mpc_tpu.obs.slo import SloTracker
+
+    # Windows scale with the interval (obs/slo.py keeps one ring slot
+    # per interval across the longest window): the production 5m/1h +
+    # 6h/3d pairs at a 0.5s interval would mean half a million slots
+    # per spec, and a seconds-long sweep could never fill them anyway.
+    return SloTracker(
+        interval_s=0.5, windows=((5.0, 60.0), (120.0, 600.0)), obs=o,
+        serve_template={
+            "p99_target_us": _env("SERVE_BENCH_SLO_P99_US", 50_000.0),
+            "goal": _env("SERVE_BENCH_SLO_GOAL", 0.999)})
+
+
+def _slo_row(slo, n_req: int, p99_us) -> dict:
+    """BENCH-row error-budget fields (obs/slo.py): worst-spec
+    compliance/budget, max fast-pair burn, and the tracking overhead
+    as the per-request amortized tick cost relative to the measured
+    p99 (main() gates <= 1%)."""
+    if slo is None:
+        return {}
+    ev = slo.evaluate()
+    row: dict = {}
+    if ev:
+        row = {
+            "slo_compliance": round(
+                min(d["compliance"] for d in ev.values()), 6),
+            "slo_budget_remaining_frac": round(
+                min(d["budget_remaining_frac"] for d in ev.values()), 6),
+            "slo_burn_fast_max": round(
+                max(d["burn_fast"] for d in ev.values()), 4),
+        }
+    if p99_us and n_req:
+        row["slo_overhead_frac"] = round(
+            (slo.total_tick_s / n_req) / (p99_us * 1e-6), 6)
+    return row
 
 
 def _phase_hists(o) -> dict:
@@ -423,9 +479,10 @@ def run_arena(out_path: str | None = None) -> dict:
             reservoir_k=64, snapshot_every_s=max(0.5, secs / 2),
             snapshot_dir=demand_dir, obs=o)
     tr = _make_trace(o)
+    slo = _make_slo(o)
     sched = ArenaScheduler(arena, max_batch=max_batch,
                            max_wait_us=wait_us, fallback=fallback,
-                           obs=o, demand=hub, trace=tr)
+                           obs=o, demand=hub, trace=tr, slo=slo)
     monitor = ContentionMonitor(
         interval_s=1.0, metrics=o.metrics if o.enabled else None).start()
 
@@ -541,6 +598,8 @@ def run_arena(out_path: str | None = None) -> dict:
     drained = arena.wait_retired(e_v1, 10.0)
     sched.close()
     host = monitor.summary()
+    if slo is not None:  # final fold: the tail of the last flush window
+        slo.tick(o.metrics.snapshot())
 
     # Demand epilogue (per-tenant): publish + strict-load every
     # tenant's snapshot; the BENCH row carries the mean top-decile
@@ -652,6 +711,7 @@ def run_arena(out_path: str | None = None) -> dict:
         **demand_row,
         **_trace_row(tr, o, top_delta, sweep_wall, gcrec, no_gc,
                      per_rate),
+        **_slo_row(slo, n_req, top_row["p99_us"]),
     }
     o.close()
     _write_result(result, out_path)
@@ -723,9 +783,10 @@ def run(out_path: str | None = None) -> dict:
                               {"v1": srv1, "v2": srv2}),
             obs=o)
     tr = _make_trace(o)
+    slo = _make_slo(o)
     sched = RequestScheduler(registry, "bench", max_batch=max_batch,
                              max_wait_us=wait_us, fallback=fallback,
-                             obs=o, demand=hub, trace=tr)
+                             obs=o, demand=hub, trace=tr, slo=slo)
 
     # Warm the compiled-shape set before the measured sweep: the pow-2
     # bucket discipline bounds it to log2(max_batch) programs per
@@ -908,6 +969,8 @@ def run(out_path: str | None = None) -> dict:
         gc.enable()
     sched.close()
     host = monitor.summary()
+    if slo is not None:  # final fold: the tail of the last flush window
+        slo.tick(o.metrics.snapshot())
 
     # Demand epilogue: drain the subopt queue synchronously, publish
     # the snapshot, and STRICT-load it back (a torn snapshot must fail
@@ -997,6 +1060,7 @@ def run(out_path: str | None = None) -> dict:
         "trace_overhead_frac": t_overhead,
         **({"trace_ab_windows": {"off": toffs, "on": tons}}
            if toffs or tons else {}),
+        **_slo_row(slo, n_req, top_row["p99_us"]),
     }
     o.close()
     _write_result(result, out_path)
@@ -1051,6 +1115,11 @@ def main() -> int:
     toh = result.get("trace_overhead_frac")
     if toh is not None:
         ok = ok and toh <= 0.01
+    # SLO tracking bar (ISSUE 20): folding budgets on the flush path
+    # must cost <= 1% of the measured p99, amortized per request.
+    soh = result.get("slo_overhead_frac")
+    if soh is not None:
+        ok = ok and soh <= 0.01
     return 0 if ok else 1
 
 

@@ -340,6 +340,69 @@ def test_quantile_mass_in_underflow_and_overflow_buckets():
     assert quantile(snap, 1.0) <= 5.0 * (1 + 1e-12)
 
 
+def test_snapshot_delta_quantiles_under_concurrent_observe():
+    """The serve_bench / obs-slo idiom -- quantiles from the DELTA of
+    two cumulative snapshots -- must stay sound while writer threads
+    observe() concurrently: every mid-flight snapshot is internally
+    consistent (sum(counts) == count) and monotone, deltas are
+    non-negative, and the delta-window quantiles track a numpy
+    reference over exactly that window's samples within one
+    log-bucket ratio (10^(1/5))."""
+    h = Histogram()
+    n_threads, n_obs = 4, 3000
+
+    def run_phase(lo_exp, hi_exp, seed0):
+        recorded = []
+
+        def writer(seed):
+            vals = 10.0 ** np.random.default_rng(seed).uniform(
+                lo_exp, hi_exp, size=n_obs)
+            for v in vals:
+                h.observe(float(v))
+            recorded.append(vals)
+
+        threads = [threading.Thread(target=writer, args=(seed0 + k,))
+                   for k in range(n_threads)]
+        for t in threads:
+            t.start()
+        prev = None
+        while any(t.is_alive() for t in threads):
+            snap = h.snapshot()
+            assert sum(snap["counts"]) == snap["count"]
+            if prev is not None:
+                assert snap["count"] >= prev["count"]
+                assert all(c >= p for c, p in zip(snap["counts"],
+                                                 prev["counts"]))
+            prev = snap
+        for t in threads:
+            t.join()
+        return np.concatenate(recorded)
+
+    vals1 = run_phase(-6, -3, 100)
+    snap1 = h.snapshot()
+    # The second phase lands in a DIFFERENT decade band, so a quantile
+    # computed from the cumulative histogram would be wrong for the
+    # window -- only the delta is right.
+    vals2 = run_phase(-4, -1, 200)
+    snap2 = h.snapshot()
+    assert snap1["count"] == vals1.size
+    assert snap2["count"] == vals1.size + vals2.size
+    delta_counts = [c - p for c, p in zip(snap2["counts"],
+                                          snap1["counts"])]
+    assert all(c >= 0 for c in delta_counts)
+    assert sum(delta_counts) == vals2.size
+    delta = {"bounds": snap2["bounds"], "counts": delta_counts,
+             "count": int(sum(delta_counts)),
+             "sum": snap2["sum"] - snap1["sum"],
+             "min": float(vals2.min()), "max": float(vals2.max())}
+    bucket_ratio = 10.0 ** (1.0 / 5.0)
+    for q in (0.5, 0.99):
+        est = quantile(delta, q)
+        ref = float(np.quantile(vals2, q))
+        assert ref / bucket_ratio <= est <= ref * bucket_ratio, (q, est,
+                                                                 ref)
+
+
 def test_registry_snapshot_and_summary():
     m = MetricsRegistry()
     m.counter("a.count").inc(3)

@@ -266,18 +266,31 @@ def test_trace_overhead_ab(rng):
             return [t.result(30.0)[0].latency_s for t in tks]
 
         window()  # warm both code paths (bucket compiles)
-        lat_off, lat_on = [], []
-        for _ in range(5):
-            sched.trace = None
-            lat_off.extend(window())
-            sched.trace = tr
-            lat_on.extend(window())
+        p99_off, p99_on = [], []
+        # GC off for the measured windows: late in the suite a gen2
+        # pass costs more than a whole 75 ms window, and the on arm's
+        # extra allocations draw it in preferentially -- that is GC
+        # accounting, not trace overhead.  The GC-inclusive gate is
+        # serve_bench's gc_pause_frac.
+        gc.collect()
+        gc.disable()
+        try:
+            for _ in range(5):
+                sched.trace = None
+                p99_off.append(
+                    np.percentile(np.asarray(window()) * 1e6, 99))
+                sched.trace = tr
+                p99_on.append(
+                    np.percentile(np.asarray(window()) * 1e6, 99))
+        finally:
+            gc.enable()
 
-    # Pooled per-arm p99 over the interleaved windows: pooling keeps
-    # the tail statistic out of single-window max territory, and the
-    # interleaving cancels host drift between arms.
-    p_off = float(np.percentile(np.asarray(lat_off) * 1e6, 99))
-    p_on = float(np.percentile(np.asarray(lat_on) * 1e6, 99))
+    # Per-arm p99 FLOORS across the interleaved pairs (the serve_bench
+    # trace_overhead methodology): a GC pass or scheduler hiccup lands
+    # in one window's tail but cannot poison the min, where a pooled
+    # per-arm p99 inherits the single worst window.
+    p_off = float(min(p99_off))
+    p_on = float(min(p99_on))
     overhead = (p_on - p_off) / p_off
     assert overhead <= 0.15
 
